@@ -1,0 +1,29 @@
+"""lighthouse_tpu — a TPU-native Ethereum consensus-layer framework.
+
+A ground-up rebuild of the capabilities of Lighthouse (the reference Rust
+client, see SURVEY.md) designed for TPUs: the data-parallel cryptographic
+hot path — BLS12-381 batch signature verification (multi-pairing, MSM) and
+hashing — runs as JAX/Pallas kernels behind a runtime-selectable backend
+seam (mirroring the reference's ``crypto/bls`` generic-backend trait,
+``crypto/bls/src/lib.rs:99-140``), while the consensus runtime (state
+transition, fork choice, storage, networking, validator client) is host
+code engineered around device-sized batches.
+
+Layout (§2 of SURVEY.md maps each subpackage to reference crates):
+  crypto/            L0  — BLS12-381 + hashing; cpu oracle + jax device stack
+  ssz/               L1  — SSZ encode/decode + merkleization
+  types/             L2  — spec datatypes, presets, ChainSpec
+  state_transition/  L2  — per-slot/block/epoch + BlockSignatureVerifier
+  fork_choice/       L2  — proto-array LMD-GHOST
+  store/             L3  — hot/cold persistence
+  chain/             L4  — BeaconChain runtime, verification pipelines, caches
+  net/               L5  — gossip/rpc host layer + beacon processor
+  api/               L6  — Beacon API (HTTP)
+  vc/                L7  — validator client + slashing protection
+  cli/               L8  — process entry points
+  parallel/          —   — device mesh / sharding helpers
+  ops/               —   — pallas kernels
+  utils/             LX  — metrics, logging, slot clock, task executor
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,11 @@
+"""L0 — cryptographic primitives (reference: ``/root/reference/crypto/``).
+
+Subpackages:
+  cpu/      pure-Python BLS12-381 (oracle + host fallback backend)
+  device/   JAX/Pallas TPU stack (limb fields, pairings, batched verify)
+  bls.py    public wrapper types + backend seam (crypto/bls generic layer)
+  backend.py runtime backend registry (cpu / fake / tpu)
+  hashing.py SHA-256 helpers (eth2_hashing equivalent)
+"""
+
+from . import params  # noqa: F401

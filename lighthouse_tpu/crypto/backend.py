@@ -1,0 +1,104 @@
+"""Runtime-selectable BLS execution backends.
+
+The reference selects its backend (``blst`` / ``milagro`` / ``fake_crypto``)
+at compile time via cargo features (``crypto/bls/src/lib.rs:8-20``); here the
+backend is a runtime choice — ``set_backend("tpu")`` or the
+``LIGHTHOUSE_TPU_BLS_BACKEND`` environment variable — because device
+availability is a runtime property on TPU hosts (this is where the
+reference's north-star ``--bls-backend tpu`` flag lands, see
+``lighthouse/environment/src/lib.rs``).
+
+Backend protocol (all points are cpu-oracle affine points; the tpu backend
+converts to device tensors internally):
+
+    verify(pk_point, message, sig_point) -> bool
+    fast_aggregate_verify(pk_points, message, sig_point) -> bool
+    aggregate_verify(pk_points, messages, sig_point) -> bool
+    verify_signature_sets([(sig_point, [pk_points], message32)]) -> bool
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, Dict
+
+from .cpu import bls as _cpu
+
+
+class CpuBackend:
+    """Pure-Python backend (analogue of the reference's milagro backend)."""
+
+    name = "cpu"
+
+    verify = staticmethod(_cpu.verify)
+    fast_aggregate_verify = staticmethod(_cpu.fast_aggregate_verify)
+    aggregate_verify = staticmethod(_cpu.aggregate_verify)
+    verify_signature_sets = staticmethod(_cpu.verify_signature_sets)
+
+
+class FakeBackend:
+    """Always-valid backend for tests that ignore crypto (reference:
+    crypto/bls/src/impls/fake_crypto.rs). Keeps the reference's edge
+    semantics: an empty batch / empty signing keys still fail."""
+
+    name = "fake"
+
+    @staticmethod
+    def verify(pk, message, sig) -> bool:
+        return True
+
+    @staticmethod
+    def fast_aggregate_verify(pks, message, sig) -> bool:
+        return bool(pks)
+
+    @staticmethod
+    def aggregate_verify(pks, messages, sig) -> bool:
+        return bool(pks) and len(pks) == len(messages)
+
+    @staticmethod
+    def verify_signature_sets(sets) -> bool:
+        sets = list(sets)
+        return bool(sets) and all(pks for _, pks, _ in sets)
+
+
+_REGISTRY: Dict[str, Callable[[], object]] = {
+    "cpu": lambda: CpuBackend(),
+    "fake": lambda: FakeBackend(),
+}
+
+_lock = threading.Lock()
+_active = None
+_active_name = None
+
+
+def register(name: str, factory: Callable[[], object]) -> None:
+    _REGISTRY[name] = factory
+
+
+def set_backend(name: str) -> None:
+    global _active, _active_name
+    with _lock:
+        if name not in _REGISTRY:
+            raise KeyError(f"unknown BLS backend {name!r}; have {sorted(_REGISTRY)}")
+        _active = _REGISTRY[name]()
+        _active_name = name
+
+
+def active():
+    global _active, _active_name
+    if _active is None:
+        with _lock:
+            if _active is None:
+                name = os.environ.get("LIGHTHOUSE_TPU_BLS_BACKEND", "cpu")
+                if name not in _REGISTRY and name == "tpu":
+                    # Lazily register the device backend on first request.
+                    from . import device  # noqa: F401  (registers "tpu")
+                _active = _REGISTRY[name]()
+                _active_name = name
+    return _active
+
+
+def active_name() -> str:
+    active()
+    return _active_name

@@ -1,0 +1,245 @@
+"""Public BLS API: the generic-backend seam.
+
+Mirrors the reference's ``crypto/bls`` generic layer
+(``/root/reference/crypto/bls/src/lib.rs:99-140``): wrapper types carry both
+serialized bytes and the decompressed point; *all* serious cryptography is
+deferred to a runtime-selectable backend (``fake`` / ``cpu`` / ``tpu``),
+where the reference selects ``blst``/``milagro``/``fake_crypto`` at compile
+time. Deserialization rules follow the reference:
+
+* public keys: 48 bytes, must decompress onto the curve, subgroup-checked,
+  infinity rejected (``generic_public_key.rs``);
+* signatures: 96 bytes, the all-zero encoding is the valid "empty"
+  (infinity) signature (``generic_signature.rs``, ``INFINITY_SIGNATURE``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from . import backend as _backend
+from .cpu import bls as _cpu
+from .cpu.curve import G1Point, G2Point
+from .params import DST, PUBLIC_KEY_BYTES, R, SECRET_KEY_BYTES, SIGNATURE_BYTES
+
+INFINITY_SIGNATURE = bytes([0xC0] + [0] * 95)
+INFINITY_PUBLIC_KEY = bytes([0xC0] + [0] * 47)
+
+
+class BlsError(ValueError):
+    pass
+
+
+class PublicKey:
+    """A decompressed, subgroup-checked G1 public key."""
+
+    __slots__ = ("point", "_bytes")
+
+    def __init__(self, point: G1Point, raw: Optional[bytes] = None):
+        self.point = point
+        self._bytes = raw
+
+    @classmethod
+    def deserialize(cls, data: bytes) -> "PublicKey":
+        if len(data) != PUBLIC_KEY_BYTES:
+            raise BlsError(f"invalid pubkey length {len(data)}")
+        try:
+            point = G1Point.decompress(data)
+        except ValueError as e:
+            raise BlsError(str(e)) from e
+        if point.is_infinity():
+            raise BlsError("infinity public key is invalid")
+        if not point.in_subgroup():
+            raise BlsError("public key not in subgroup")
+        return cls(point, bytes(data))
+
+    def serialize(self) -> bytes:
+        if self._bytes is None:
+            self._bytes = self.point.compress()
+        return self._bytes
+
+    def __eq__(self, o):
+        return isinstance(o, PublicKey) and self.serialize() == o.serialize()
+
+    def __hash__(self):
+        return hash(self.serialize())
+
+    def __repr__(self):
+        return f"PublicKey(0x{self.serialize().hex()})"
+
+
+class Signature:
+    """A G2 signature; ``point`` is None for the "empty" (infinity) encoding."""
+
+    __slots__ = ("point", "_bytes")
+
+    def __init__(self, point: Optional[G2Point], raw: Optional[bytes] = None):
+        self.point = point
+        self._bytes = raw
+
+    @classmethod
+    def deserialize(cls, data: bytes) -> "Signature":
+        if len(data) != SIGNATURE_BYTES:
+            raise BlsError(f"invalid signature length {len(data)}")
+        if bytes(data) == INFINITY_SIGNATURE:
+            return cls(None, INFINITY_SIGNATURE)
+        try:
+            point = G2Point.decompress(data)
+        except ValueError as e:
+            raise BlsError(str(e)) from e
+        return cls(point, bytes(data))
+
+    @classmethod
+    def infinity(cls) -> "Signature":
+        return cls(None, INFINITY_SIGNATURE)
+
+    def serialize(self) -> bytes:
+        if self._bytes is None:
+            self._bytes = (
+                INFINITY_SIGNATURE if self.point is None else self.point.compress()
+            )
+        return self._bytes
+
+    def point_or_infinity(self) -> G2Point:
+        return G2Point.infinity() if self.point is None else self.point
+
+    def is_infinity(self) -> bool:
+        return self.point is None or self.point.is_infinity()
+
+    def verify(self, pk: PublicKey, message: bytes) -> bool:
+        return _backend.active().verify(pk.point, message, self.point_or_infinity())
+
+    def __eq__(self, o):
+        return isinstance(o, Signature) and self.serialize() == o.serialize()
+
+    def __hash__(self):
+        return hash(self.serialize())
+
+    def __repr__(self):
+        return f"Signature(0x{self.serialize().hex()})"
+
+
+class AggregateSignature(Signature):
+    """A signature accumulating others by point addition (reference:
+    generic_aggregate_signature.rs add_assign / add_assign_aggregate)."""
+
+    @classmethod
+    def infinity(cls) -> "AggregateSignature":
+        return cls(None, INFINITY_SIGNATURE)
+
+    def add_assign(self, other: Signature) -> None:
+        if other.point is None:
+            return
+        if self.point is None:
+            self.point = other.point
+        else:
+            self.point = self.point + other.point
+        self._bytes = None
+
+    def fast_aggregate_verify(self, message: bytes, pks: Sequence[PublicKey]) -> bool:
+        if not pks:
+            return False
+        return _backend.active().fast_aggregate_verify(
+            [pk.point for pk in pks], message, self.point_or_infinity()
+        )
+
+    def aggregate_verify(
+        self, messages: Sequence[bytes], pks: Sequence[PublicKey]
+    ) -> bool:
+        if not pks or len(pks) != len(messages):
+            return False
+        return _backend.active().aggregate_verify(
+            [pk.point for pk in pks], list(messages), self.point_or_infinity()
+        )
+
+
+class SecretKey:
+    __slots__ = ("k",)
+
+    def __init__(self, k: int):
+        if not 0 < k < R:
+            raise BlsError("secret key out of range")
+        self.k = k
+
+    @classmethod
+    def deserialize(cls, data: bytes) -> "SecretKey":
+        if len(data) != SECRET_KEY_BYTES:
+            raise BlsError("invalid secret key length")
+        return cls(int.from_bytes(data, "big"))
+
+    def serialize(self) -> bytes:
+        return self.k.to_bytes(SECRET_KEY_BYTES, "big")
+
+    def public_key(self) -> PublicKey:
+        return PublicKey(_cpu.sk_to_pk(self.k))
+
+    def sign(self, message: bytes) -> Signature:
+        return Signature(_cpu.sign(self.k, message))
+
+
+class SignatureSet:
+    """A signature over one message by one or more public keys — the unit
+    of batch verification (reference: generic_signature_set.rs:61-107)."""
+
+    __slots__ = ("signature", "signing_keys", "message")
+
+    def __init__(
+        self,
+        signature: Signature,
+        signing_keys: Sequence[PublicKey],
+        message: bytes,
+    ):
+        if len(message) != 32:
+            raise BlsError("message must be a 32-byte signing root")
+        self.signature = signature
+        self.signing_keys = list(signing_keys)
+        self.message = bytes(message)
+
+    @classmethod
+    def single_pubkey(
+        cls, signature: Signature, signing_key: PublicKey, message: bytes
+    ) -> "SignatureSet":
+        return cls(signature, [signing_key], message)
+
+    @classmethod
+    def multiple_pubkeys(
+        cls, signature: Signature, signing_keys: Sequence[PublicKey], message: bytes
+    ) -> "SignatureSet":
+        return cls(signature, signing_keys, message)
+
+    def verify(self) -> bool:
+        """Verify just this set (fast_aggregate_verify)."""
+        return AggregateSignature(
+            self.signature.point, self.signature.serialize()
+        ).fast_aggregate_verify(self.message, self.signing_keys)
+
+
+def verify_signature_sets(sets: Sequence[SignatureSet]) -> bool:
+    """Batch-verify; `True` iff every set verifies (modulo the standard
+    2^-64 random-linear-combination soundness)."""
+    sets = list(sets)
+    if not sets:
+        return False
+    raw = []
+    for s in sets:
+        # An "empty" (infinity-encoded) signature fails the whole batch
+        # before reaching any backend (blst.rs:77-83).
+        if s.signature.point is None:
+            return False
+        raw.append(
+            (s.signature.point, [pk.point for pk in s.signing_keys], s.message)
+        )
+    return _backend.active().verify_signature_sets(raw)
+
+
+__all__ = [
+    "AggregateSignature",
+    "BlsError",
+    "DST",
+    "INFINITY_SIGNATURE",
+    "PublicKey",
+    "SecretKey",
+    "Signature",
+    "SignatureSet",
+    "verify_signature_sets",
+]

@@ -1,0 +1,21 @@
+"""Pure-Python CPU backend for BLS12-381.
+
+This is the structural analogue of the reference's ``milagro`` backend
+(``/root/reference/crypto/bls/src/impls/milagro.rs``): a from-scratch,
+dependency-free implementation of the full signature scheme in the host
+language. It serves two roles:
+
+1. the ``cpu`` entry of the runtime-selectable backend seam
+   (``lighthouse_tpu.crypto.backend``), used for host-side point
+   decompression and as a correctness fallback; and
+2. the oracle that certifies the JAX/TPU device stack — every device
+   kernel is tested for bit-equality against this module.
+
+Not constant-time; the consensus client only ever verifies public data on
+this path (signing keys for the validator client use the same math but the
+VC threat model matches the reference's, which also does not claim
+side-channel hardening for its pure-Rust backend).
+"""
+
+from .fields import Fq, Fq2, Fq6, Fq12  # noqa: F401
+from .curve import G1Point, G2Point, g1_generator, g2_generator  # noqa: F401

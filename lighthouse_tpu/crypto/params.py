@@ -1,0 +1,55 @@
+"""BLS12-381 domain parameters.
+
+These are the public curve constants of BLS12-381 as standardised for the
+Ethereum consensus layer (min_pk ciphersuite
+``BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_``), mirroring what the
+reference links via the ``blst``/``milagro`` backends
+(``/root/reference/crypto/bls/src/impls/blst.rs:9-14``).
+
+The 3-isogeny constants used by hash-to-G2 (RFC 9380 §8.8.2) are *derived*
+in-repo by ``tools/derive_iso3.py`` (Vélu's formulas over Fp2) and committed
+in ``iso3_g2.py`` — see that tool for the derivation and the checks pinning
+it to the standard map.
+"""
+
+# Base field prime.
+P = 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAAB
+
+# Subgroup order (scalar field).
+R = 0x73EDA753299D7D483339D80809A1D80553BDA402FFFE5BFEFFFFFFFF00000001
+
+# BLS parameter x (negative). |X| has 64 bits; X = -2^63 - 2^62 - 2^60 - 2^57 - 2^48 - 2^16.
+X = -0xD201000000010000
+
+# Curve equations: G1/E1: y^2 = x^3 + 4 over Fp; G2/E2: y^2 = x^3 + 4(u+1) over Fp2.
+B1 = 4
+B2 = (4, 4)  # 4 * (1 + u)
+
+# Cofactors.
+H1 = 0x396C8C005555E1568C00AAAB0000AAAB
+H2 = 0x5D543A95414E7F1091D50792876A202CD91DE4547085ABAA68A205B2E5A7DDFA628F1CB4D9E82EF21537E293A6691AE1616EC6E786F0C70CF1C38E31C7238E5
+
+# Standard generators (validated in tests: on-curve, r-torsion, and the
+# interop keypair vectors from
+# /root/reference/common/eth2_interop_keypairs/specs/keygen_10_validators.yaml
+# certify G1 generator + serialization bit-exactly).
+G1_X = 0x17F1D3A73197D7942695638C4FA9AC0FC3688C4F9774B905A14E3A3F171BAC586C55E83FF97A1AEFFB3AF00ADB22C6BB
+G1_Y = 0x08B3F481E3AAA0F1A09E30ED741D8AE4FCF5E095D5D00AF600DB18CB2C04B3EDD03CC744A2888AE40CAA232946C5E7E1
+
+G2_X0 = 0x024AA2B2F08F0A91260805272DC51051C6E47AD4FA403B02B4510B647AE3D1770BAC0326A805BBEFD48056C8C121BDB8
+G2_X1 = 0x13E02B6052719F607DACD3A088274F65596BD0D09920B61AB5DA61BBDC7F5049334CF11213945D57E5AC7D055D042B7E
+G2_Y0 = 0x0CE5D527727D6E118CC9CDC6DA2E351AADFD9BAA8CBDD3A76D429A695160D12C923AC9CC3BACA289E193548608B82801
+G2_Y1 = 0x0606C4A02EA734CC32ACD2B02BC28B99CB3E287E85A763AF267492AB572E99AB3F370D275CEC1DA1AAA9075FF05F79BE
+
+# Ciphersuite domain-separation tag (reference: crypto/bls/src/impls/blst.rs:14).
+DST = b"BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_"
+
+# SSWU parameters for the 3-isogenous curve E2': y^2 = x^3 + A'x + B'
+# (RFC 9380 §8.8.2): A' = 240*u, B' = 1012*(1+u), Z = -(2+u).
+ISO3_A = (0, 240)
+ISO3_B = (1012, 1012)
+ISO3_Z = (P - 2, P - 1)
+
+SECRET_KEY_BYTES = 32
+PUBLIC_KEY_BYTES = 48
+SIGNATURE_BYTES = 96

