@@ -1,0 +1,15 @@
+"""Device (JAX/TPU) BLS12-381 stack.
+
+This package is the TPU-native re-design of the reference's native crypto
+backends (``/root/reference/crypto/bls/src/impls/blst.rs`` — x86-64
+asm + C): instead of per-core SIMD pairings it evaluates *batches* of
+pairings/scalar-muls as data-parallel JAX programs whose batch dimension is
+the signature-set dimension of
+``verify_signature_sets`` (``blst.rs:36-119``).
+
+Layout: a base-field element is an ``int32[..., 32]`` array of 12-bit limbs
+(little-endian); every operation broadcasts over leading batch dimensions,
+so the whole tower/curve/pairing stack is batched by construction — no
+``vmap`` required. Bounds guaranteeing no int32 overflow are checked by
+interval arithmetic at import time (see ``fp.py``).
+"""

@@ -1,0 +1,332 @@
+"""BLS12-381 base field Fp on device: 12-bit x 32 limb arithmetic in int32.
+
+Design (TPU-first, not a port of blst's 64-bit asm):
+
+* An Fp element is ``int32[..., 32]``: 32 little-endian limbs of 12 bits.
+  381-bit values fit in 384 bits. Leading dims are batch dims; every op
+  broadcasts, so the whole stack is batched without ``vmap``.
+* 12-bit limbs are chosen so schoolbook products never overflow int32:
+  a full-product column is at most ``16 * LIMB_MAX**2 < 2**31``. The TPU
+  VPU has no 64-bit multiply-high; 12x12->24-bit products with 32-bit
+  accumulation map directly onto int32 vector lanes.
+* Multiplication = banded-Toeplitz matmul: gather ``y`` into a
+  ``[..., 32, 63]`` band matrix, one batched ``dot_general`` computes all
+  63 product columns (2016 MACs — the minimal schoolbook work), then the
+  columns are reduced mod p by folding limbs >= 32 through a precomputed
+  ``2**(12*i) mod p`` table (another small matmul). No Montgomery form:
+  the fold table plays the role blst's Montgomery REDC plays
+  (``/root/reference/crypto/bls/src/impls/blst.rs`` links the asm).
+* Values are kept *relaxed*: limbs in ``[0, LIMB_MAX]``, value in
+  ``[0, 2**384)``-ish, only congruent mod p. ``canonical`` produces the
+  unique strict representative for equality/serialization.
+* Every reduction plan is derived at trace time by exact interval
+  arithmetic on per-limb bounds, asserting that no intermediate can
+  overflow int32 — machine-checked, not hand-waved.
+
+Subtraction uses a "saturated" multiple of p (every digit >= LIMB_MAX) so
+``x - y + SAT`` is limb-wise non-negative — branch-free and select-free.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..params import P
+
+# ---------------------------------------------------------------------------
+# Layout constants
+# ---------------------------------------------------------------------------
+
+W = 12                    # bits per limb
+NL = 32                   # limbs per element (384 bits >= 381)
+MASK = (1 << W) - 1       # 0xFFF
+LIMB_MAX = 8191           # relaxed per-limb bound maintained by reduce_cols
+NCOLS = 2 * NL - 1        # full-product column count
+
+# Products are accumulated in int32 over *half* the limbs at a time
+# (16 * LIMB_MAX**2 < 2**31); see mul().
+assert (NL // 2) * (LIMB_MAX ** 2) < 2 ** 31, "half-conv columns must fit int32"
+
+
+# ---------------------------------------------------------------------------
+# Host-side packing helpers
+# ---------------------------------------------------------------------------
+
+def int_to_limbs(x: int) -> np.ndarray:
+    """Python int -> strict little-endian 12-bit limbs, int32[32]."""
+    assert 0 <= x < 1 << (W * NL)
+    return np.array([(x >> (W * i)) & MASK for i in range(NL)], np.int32)
+
+
+def limbs_to_int(a) -> int:
+    """Limb array (any relaxed representation) -> Python int value."""
+    a = np.asarray(a)
+    return sum(int(v) << (W * i) for i, v in enumerate(a.reshape(-1).tolist()))
+
+
+def _digits(x: int, n: int) -> list[int]:
+    return [(x >> (W * i)) & MASK for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# Module-level tables (numpy; jnp converts on first use)
+# ---------------------------------------------------------------------------
+
+# Fold table: FOLD[i] = limbs of 2**(W*(NL+i)) mod p, for high limb NL+i.
+_FOLD_HI = 64
+FOLD = np.stack(
+    [int_to_limbs(pow(1 << W, NL + i, P)) for i in range(_FOLD_HI)]
+)  # [64, 32] int32, strict digits
+
+# Banded-Toeplitz gather index/mask for multiplication.
+_IDX = np.zeros((NL, NCOLS), np.int32)
+_BANDMASK = np.zeros((NL, NCOLS), np.int32)
+for _a in range(NL):
+    for _c in range(NCOLS):
+        _d = _c - _a
+        if 0 <= _d < NL:
+            _IDX[_a, _c] = _d
+            _BANDMASK[_a, _c] = 1
+
+# Saturated multiple of p for branch-free subtraction: SAT digits all in
+# [LIMB_MAX, ...], value = m*p. Found by a small search.
+def _saturated_multiple() -> tuple[np.ndarray, int]:
+    S = sum(1 << (W * i) for i in range(NL))  # all-ones weight sum
+    for m in range(10, 64):
+        t = m * P - LIMB_MAX * S
+        if t < 0:
+            continue
+        d = _digits(t, NL)
+        if sum(v << (W * i) for i, v in enumerate(d)) != t:
+            continue  # does not fit in 32 digits
+        sat = [LIMB_MAX + v for v in d]
+        if max(sat) * 2 < 2 ** 20:  # comfortably small
+            return np.array(sat, np.int32), m
+    raise AssertionError("no saturated multiple of p found")
+
+
+SAT, _SAT_M = _saturated_multiple()
+assert limbs_to_int(SAT) == _SAT_M * P
+
+# Strict digits of 2**384 - k*p for canonical conditional subtraction.
+_CSUB_KS = (8, 4, 2, 1)
+CSUB = np.stack([np.array(_digits((1 << (W * NL)) - k * P, NL), np.int32)
+                 for k in _CSUB_KS])
+
+ZERO = int_to_limbs(0)
+ONE = int_to_limbs(1)
+
+
+# ---------------------------------------------------------------------------
+# Reduction: columns -> relaxed 32-limb representative (mod p)
+# ---------------------------------------------------------------------------
+
+def _carry_round(cols, bounds):
+    """One parallel carry round; widens by one limb. Exact value preserved."""
+    assert all(b < 2 ** 31 for b in bounds), f"int32 overflow risk: {bounds}"
+    r = cols & MASK
+    c = cols >> W
+    pad = [(0, 0)] * (cols.ndim - 1)
+    r = jnp.pad(r, pad + [(0, 1)])
+    c = jnp.pad(c, pad + [(1, 0)])
+    rb = [min(b, MASK) for b in bounds] + [0]
+    cb = [0] + [b >> W for b in bounds]
+    return r + c, [a + b for a, b in zip(rb, cb)]
+
+
+def _fold_round(cols, bounds):
+    """Fold limbs >= NL through the 2**(12i) mod p table (exact mod p)."""
+    n = len(bounds)
+    k = n - NL
+    assert k > 0
+    lo, hi = cols[..., :NL], cols[..., NL:]
+    table = jnp.asarray(FOLD[:k])
+    out = lo + jnp.einsum("...h,hl->...l", hi, table,
+                          preferred_element_type=jnp.int32)
+    ob = [bounds[i] + sum(bounds[NL + h] * int(FOLD[h, i]) for h in range(k))
+          for i in range(NL)]
+    assert all(b < 2 ** 31 for b in ob), f"fold overflow risk: {ob}"
+    return out, ob
+
+
+def _fold_safe(bounds) -> bool:
+    k = len(bounds) - NL
+    if k <= 0:
+        return False
+    return all(
+        bounds[i] + sum(bounds[NL + h] * int(FOLD[h, i]) for h in range(k))
+        < 2 ** 31
+        for i in range(NL)
+    )
+
+
+def reduce_cols(cols, bounds):
+    """Reduce arbitrary product columns to the relaxed 32-limb form.
+
+    ``bounds`` is a Python list of exact per-column upper bounds; the
+    carry/fold schedule is chosen at trace time and asserts int32 safety
+    for every intermediate.
+    """
+    bounds = list(bounds)
+    assert cols.shape[-1] == len(bounds)
+    for _ in range(32):
+        if len(bounds) == NL and max(bounds) <= LIMB_MAX:
+            return cols
+        if _fold_safe(bounds):
+            cols, bounds = _fold_round(cols, bounds)
+        else:
+            cols, bounds = _carry_round(cols, bounds)
+    raise AssertionError(f"reduction did not converge: {bounds}")
+
+
+# ---------------------------------------------------------------------------
+# Field operations (all broadcast over leading dims)
+# ---------------------------------------------------------------------------
+
+_B_IN = [LIMB_MAX] * NL  # invariant bound on any input element
+
+
+def add(x, y):
+    return reduce_cols(x + y, [2 * LIMB_MAX] * NL)
+
+
+def sub(x, y):
+    return reduce_cols(x + (jnp.asarray(SAT) - y),
+                       [LIMB_MAX + int(v) for v in SAT])
+
+
+def neg(x):
+    return reduce_cols(jnp.asarray(SAT) - x, [int(v) for v in SAT])
+
+
+def mul_small(x, k: int):
+    """Multiply by a small non-negative Python int (k * LIMB_MAX < 2**31)."""
+    assert 0 <= k and k * LIMB_MAX < 2 ** 31
+    return reduce_cols(x * k, [k * LIMB_MAX] * NL)
+
+
+def _overlap(c: int, lo: int, hi: int) -> int:
+    """Number of a in [lo, hi) with 0 <= c - a < NL (terms in column c)."""
+    return max(0, min(c, hi - 1) - max(lo, c - (NL - 1)) + 1)
+
+
+_H = NL // 2
+_HALF_BOUNDS = [
+    [_overlap(c, 0, _H) * LIMB_MAX ** 2 for c in range(NCOLS)],
+    [_overlap(c, _H, NL) * LIMB_MAX ** 2 for c in range(NCOLS)],
+]
+
+
+def mul(x, y):
+    """Banded-Toeplitz schoolbook product, split into two 16-limb dots so
+    int32 accumulation cannot overflow at LIMB_MAX; each half gets one
+    carry round before the halves are combined and reduced."""
+    band = jnp.take(y, jnp.asarray(_IDX), axis=-1) * jnp.asarray(_BANDMASK)
+    halves = []
+    for i, sl in enumerate((slice(0, _H), slice(_H, NL))):
+        cols = jnp.einsum("...a,...ac->...c", x[..., sl], band[..., sl, :],
+                          preferred_element_type=jnp.int32)
+        halves.append(_carry_round(cols, _HALF_BOUNDS[i]))
+    (c0, b0), (c1, b1) = halves
+    return reduce_cols(c0 + c1, [a + b for a, b in zip(b0, b1)])
+
+
+def sq(x):
+    return mul(x, x)
+
+
+# ---------------------------------------------------------------------------
+# Canonicalization and predicates
+# ---------------------------------------------------------------------------
+
+def _seq_carry(cols):
+    """Exact sequential carry over limbs -> (strict digits, carry_out)."""
+    x = jnp.moveaxis(cols, -1, 0)
+
+    def body(carry, col):
+        s = col + carry
+        return s >> W, s & MASK
+
+    carry_out, digits = lax.scan(body, jnp.zeros(x.shape[1:], x.dtype), x)
+    return jnp.moveaxis(digits, 0, -1), carry_out
+
+
+def canonical(x):
+    """Unique strict representative in [0, p), digits in [0, 4095]."""
+    d, c = _seq_carry(x)
+    # Relaxed values are < LIMB_MAX * sum(2^(12i)) < 2.0003 * 2**384, so the
+    # first carry-out is at most 2; two fold-and-recarry rounds bring the
+    # value strictly below 2**384 (each round: v -> v mod 2**384 + c * (2**384
+    # mod p), and 2**384 mod p < 2**381).
+    for _ in range(2):
+        d = d + c[..., None] * jnp.asarray(FOLD[0])
+        d, c = _seq_carry(d)
+    # Now x < 2**384 < 16p: conditional cascade subtract 8p, 4p, 2p, p.
+    for i in range(len(_CSUB_KS)):
+        s, c = _seq_carry(d + jnp.asarray(CSUB[i]))
+        d = jnp.where((c == 1)[..., None], s, d)
+    return d
+
+
+def is_zero(x):
+    """Boolean [...] mask: value == 0 mod p."""
+    return jnp.all(canonical(x) == 0, axis=-1)
+
+
+def eq(x, y):
+    return jnp.all(canonical(x) == canonical(y), axis=-1)
+
+
+def select(mask, a, b):
+    """mask [...] bool -> elementwise field select."""
+    return jnp.where(mask[..., None], a, b)
+
+
+# ---------------------------------------------------------------------------
+# Exponentiation (fixed Python-int exponent) and inversion
+# ---------------------------------------------------------------------------
+
+def _bits_msb(e: int) -> np.ndarray:
+    return np.array([int(b) for b in bin(e)[2:]], np.int32)
+
+
+def pow_const(x, e: int):
+    """x**e for a fixed exponent, as a scan over its bits (MSB first)."""
+    assert e >= 1
+    bits = _bits_msb(e)
+    if len(bits) == 1:
+        return x
+
+    def body(acc, bit):
+        acc = sq(acc)
+        acc = select(bit == 1, mul(acc, x), acc)
+        return acc, None
+
+    acc, _ = lax.scan(body, x, jnp.asarray(bits[1:]))
+    return acc
+
+
+def inv(x):
+    """Fermat inverse x**(p-2); inv(0) = 0 (callers mask separately)."""
+    return pow_const(x, P - 2)
+
+
+# ---------------------------------------------------------------------------
+# Constants / conversion on device
+# ---------------------------------------------------------------------------
+
+def const(v: int):
+    """Embed a fixed field value (shape [32]; broadcasts against batches)."""
+    return jnp.asarray(int_to_limbs(v % P))
+
+
+def zeros(shape=()):
+    return jnp.zeros((*shape, NL), jnp.int32)
+
+
+def ones(shape=()):
+    return jnp.broadcast_to(jnp.asarray(ONE), (*shape, NL)).astype(jnp.int32)

@@ -1,0 +1,119 @@
+"""Device Fp limb arithmetic vs Python-int ground truth."""
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.crypto.params import P
+from lighthouse_tpu.crypto.device import fp
+
+
+def _rand_elems(rng, n):
+    return [rng.randrange(P) for _ in range(n)]
+
+
+def _pack(vals):
+    return np.stack([fp.int_to_limbs(v) for v in vals])
+
+
+def _val(arr):
+    arr = np.asarray(arr)
+    if arr.ndim == 1:
+        return fp.limbs_to_int(arr) % P
+    return [fp.limbs_to_int(a) % P for a in arr]
+
+
+EDGES = [0, 1, 2, P - 1, P - 2, (1 << 381) % P, 0xFFF, 1 << 372]
+
+
+def test_roundtrip_limbs():
+    for v in EDGES:
+        assert fp.limbs_to_int(fp.int_to_limbs(v)) == v
+
+
+def test_add_sub_mul_batched(rng):
+    xs = _rand_elems(rng, 8) + EDGES
+    ys = EDGES + _rand_elems(rng, 8)
+    X, Y = _pack(xs), _pack(ys)
+    assert _val(fp.add(X, Y)) == [(a + b) % P for a, b in zip(xs, ys)]
+    assert _val(fp.sub(X, Y)) == [(a - b) % P for a, b in zip(xs, ys)]
+    assert _val(fp.mul(X, Y)) == [(a * b) % P for a, b in zip(xs, ys)]
+    assert _val(fp.neg(X)) == [(-a) % P for a in xs]
+    assert _val(fp.sq(X)) == [a * a % P for a in xs]
+
+
+def test_relaxed_invariant_holds_after_chains(rng):
+    """Chained ops keep limbs within [0, LIMB_MAX] (the documented invariant
+    that makes every overflow bound valid)."""
+    xs = _rand_elems(rng, 4) + [P - 1, 0]
+    X = _pack(xs)
+    acc = X
+    for _ in range(5):
+        acc = fp.mul(fp.add(acc, X), fp.sub(acc, X))
+        arr = np.asarray(acc)
+        assert arr.min() >= 0 and arr.max() <= fp.LIMB_MAX
+    expect = xs
+    acc2 = list(xs)
+    for _ in range(5):
+        acc2 = [((a + x) * (a - x)) % P for a, x in zip(acc2, expect)]
+    assert _val(acc) == acc2
+
+
+def test_canonical_strict_and_unique(rng):
+    xs = _rand_elems(rng, 4) + EDGES
+    X = _pack(xs)
+    # Push through ops to get relaxed representations, then canonicalize.
+    relaxed = fp.add(fp.mul(X, X), X)
+    can = np.asarray(fp.canonical(relaxed))
+    assert can.max() <= 0xFFF
+    assert [fp.limbs_to_int(c) for c in can] == [(x * x + x) % P for x in xs]
+
+
+def test_canonical_handles_value_just_below_2_384():
+    # Largest relaxed-representable stress value: all limbs at LIMB_MAX.
+    arr = np.full((fp.NL,), fp.LIMB_MAX, np.int32)
+    v = fp.limbs_to_int(arr)
+    can = np.asarray(fp.canonical(arr))
+    assert fp.limbs_to_int(can) == v % P
+    assert can.max() <= 0xFFF
+
+
+def test_mul_small():
+    for k in (0, 1, 2, 3, 8, 12):
+        X = _pack(EDGES)
+        assert _val(fp.mul_small(X, k)) == [(v * k) % P for v in EDGES]
+
+
+def test_eq_is_zero(rng):
+    x = rng.randrange(P)
+    X = _pack([x, x, 0, P - 1])
+    Y = _pack([x, (x + 1) % P, 0, P - 1])
+    # compare relaxed vs strict forms
+    Xr = fp.add(X, _pack([0, 0, 0, 0]))
+    assert list(np.asarray(fp.eq(Xr, Y))) == [True, False, True, True]
+    Z = fp.sub(X, Y)
+    assert list(np.asarray(fp.is_zero(Z))) == [True, False, True, True]
+
+
+def test_pow_inv(rng):
+    xs = _rand_elems(rng, 3) + [1, P - 1]
+    X = _pack(xs)
+    e = rng.randrange(1, P)
+    assert _val(fp.pow_const(X, e)) == [pow(x, e, P) for x in xs]
+    inv = _val(fp.inv(X))
+    for x, i in zip(xs, inv):
+        assert (x * i) % P == 1
+    # inv(0) == 0 convention
+    assert _val(fp.inv(_pack([0])))[0] == 0
+
+
+def test_select():
+    X, Y = _pack([1, 2]), _pack([3, 4])
+    out = _val(fp.select(np.array([True, False]), X, Y))
+    assert out == [1, 4]
+
+
+def test_broadcast_leading_dims(rng):
+    xs = _rand_elems(rng, 6)
+    X = _pack(xs).reshape(2, 3, fp.NL)
+    out = np.asarray(fp.mul(X, X)).reshape(6, fp.NL)
+    assert [fp.limbs_to_int(a) % P for a in out] == [x * x % P for x in xs]
