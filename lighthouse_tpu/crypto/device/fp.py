@@ -41,6 +41,7 @@ from ..params import P
 # Layout constants
 # ---------------------------------------------------------------------------
 
+ELEM_NDIM = 1             # trailing element dims of an fp array: (NL,)
 W = 12                    # bits per limb
 NL = 32                   # limbs per element (384 bits >= 381)
 MASK = (1 << W) - 1       # 0xFFF
@@ -294,20 +295,29 @@ def _bits_msb(e: int) -> np.ndarray:
     return np.array([int(b) for b in bin(e)[2:]], np.int32)
 
 
-def pow_const(x, e: int):
-    """x**e for a fixed exponent, as a scan over its bits (MSB first)."""
+def square_multiply(x, e: int, sq_fn, mul_fn, select_fn):
+    """Shared fixed-exponent square-and-multiply ladder (MSB-first scan).
+
+    Serves every pow_const in the device stack (fp/fp2/fp12) — one place
+    to fix or re-window the ladder. ``e`` must be >= 1.
+    """
     assert e >= 1
     bits = _bits_msb(e)
     if len(bits) == 1:
         return x
 
     def body(acc, bit):
-        acc = sq(acc)
-        acc = select(bit == 1, mul(acc, x), acc)
+        acc = sq_fn(acc)
+        acc = select_fn(bit == 1, mul_fn(acc, x), acc)
         return acc, None
 
     acc, _ = lax.scan(body, x, jnp.asarray(bits[1:]))
     return acc
+
+
+def pow_const(x, e: int):
+    """x**e for a fixed exponent, as a scan over its bits (MSB first)."""
+    return square_multiply(x, e, sq, mul, select)
 
 
 def inv(x):

@@ -1,0 +1,125 @@
+"""BLS12-381 quadratic extension Fp2 = Fp[u]/(u^2+1) on device.
+
+An Fp2 element is ``int32[..., 2, 32]``: axis -2 stacks (c0, c1), axis -1
+is the 12-bit limb axis of :mod:`.fp`. All ops broadcast over leading batch
+dims, mirroring the host oracle ``crypto/cpu/fields.Fq2`` (tested for
+bit-equality against it). Reference behaviour being reproduced: the Fp2
+tower inside blst (``/root/reference/crypto/bls/src/impls/blst.rs`` links
+the asm backend).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+from . import fp
+
+# Trailing element dims of an fp2 array: (2, NL).
+ELEM_NDIM = 2
+
+
+def pack(c0, c1):
+    """Two fp elements [..., 32] -> one fp2 element [..., 2, 32]."""
+    return jnp.stack([c0, c1], axis=-2)
+
+
+def c0(x):
+    return x[..., 0, :]
+
+
+def c1(x):
+    return x[..., 1, :]
+
+
+def const(v0: int, v1: int):
+    return pack(fp.const(v0), fp.const(v1))
+
+
+def zeros(shape=()):
+    return jnp.zeros((*shape, 2, fp.NL), jnp.int32)
+
+
+def ones(shape=()):
+    return pack(fp.ones(shape), fp.zeros(shape))
+
+
+def add(x, y):
+    return fp.add(x, y)  # limbwise; fp ops broadcast over the (2,) axis
+
+
+def sub(x, y):
+    return fp.sub(x, y)
+
+
+def neg(x):
+    return fp.neg(x)
+
+
+def mul_small(x, k: int):
+    return fp.mul_small(x, k)
+
+
+def mul(x, y):
+    """(a0 + a1 u)(b0 + b1 u) = (a0 b0 - a1 b1) + (a0 b1 + a1 b0) u."""
+    a0, a1 = c0(x), c1(x)
+    b0, b1 = c0(y), c1(y)
+    t0 = fp.mul(a0, b0)
+    t1 = fp.mul(a1, b1)
+    # Karatsuba middle term: (a0+a1)(b0+b1) - t0 - t1.
+    m = fp.mul(fp.add(a0, a1), fp.add(b0, b1))
+    return pack(fp.sub(t0, t1), fp.sub(fp.sub(m, t0), t1))
+
+
+def sq(x):
+    """(a0 + a1 u)^2 = (a0+a1)(a0-a1) + 2 a0 a1 u."""
+    a0, a1 = c0(x), c1(x)
+    t = fp.mul(a0, a1)
+    return pack(fp.mul(fp.add(a0, a1), fp.sub(a0, a1)), fp.add(t, t))
+
+
+def conjugate(x):
+    return pack(c0(x), fp.neg(c1(x)))
+
+
+def scale(x, k):
+    """Multiply both components by an fp element ``k`` [..., 32]."""
+    return pack(fp.mul(c0(x), k), fp.mul(c1(x), k))
+
+
+def mul_by_u_plus_1(x):
+    """Multiply by the sextic non-residue xi = 1 + u:
+    (a0 + a1 u)(1 + u) = (a0 - a1) + (a0 + a1) u."""
+    a0, a1 = c0(x), c1(x)
+    return pack(fp.sub(a0, a1), fp.add(a0, a1))
+
+
+def inv(x):
+    """(a0 - a1 u) / (a0^2 + a1^2); inv(0) = 0 (callers mask)."""
+    a0, a1 = c0(x), c1(x)
+    d = fp.inv(fp.add(fp.mul(a0, a0), fp.mul(a1, a1)))
+    return pack(fp.mul(a0, d), fp.neg(fp.mul(a1, d)))
+
+
+def canonical(x):
+    return fp.canonical(x)
+
+
+def is_zero(x):
+    return jnp.all(canonical(x) == 0, axis=(-1, -2))
+
+
+def eq(x, y):
+    return jnp.all(canonical(x) == canonical(y), axis=(-1, -2))
+
+
+def select(mask, a, b):
+    """mask [...] bool -> elementwise fp2 select."""
+    return jnp.where(mask[..., None, None], a, b)
+
+
+def pow_const(x, e: int):
+    """x**e for a fixed Python-int exponent (shared ladder in fp)."""
+    return fp.square_multiply(x, e, sq, mul, select)

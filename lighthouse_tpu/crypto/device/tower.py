@@ -1,0 +1,230 @@
+"""Device extension-field tower Fp6 / Fp12 for the BLS12-381 pairing.
+
+Layouts (leading dims are batch dims, broadcast everywhere):
+
+* Fp6  = Fp2[v]/(v^3 - xi), xi = 1+u:  ``int32[..., 3, 2, 32]``
+* Fp12 = Fp6[w]/(w^2 - v):             ``int32[..., 2, 3, 2, 32]``
+
+Algorithms mirror the host oracle ``crypto/cpu/fields.{Fq6,Fq12}`` (tested
+for bit-equality), expressed over the batched :mod:`.fp2` primitives.
+Frobenius constants are computed at import from public curve parameters
+(same derivation as the oracle's ``GAMMA6_1/GAMMA6_2/GAMMA12``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..params import P
+from ..cpu.fields import GAMMA6_1, GAMMA6_2, GAMMA12
+from . import fp, fp2
+
+ELEM_NDIM_6 = 3
+ELEM_NDIM_12 = 4
+
+
+# ---------------------------------------------------------------------------
+# Fp6
+# ---------------------------------------------------------------------------
+
+def f6_pack(c0, c1, c2):
+    return jnp.stack([c0, c1, c2], axis=-3)
+
+
+def f6_c(x, i):
+    return x[..., i, :, :]
+
+
+def f6_zeros(shape=()):
+    return jnp.zeros((*shape, 3, 2, fp.NL), jnp.int32)
+
+
+def f6_ones(shape=()):
+    return f6_pack(fp2.ones(shape), fp2.zeros(shape), fp2.zeros(shape))
+
+
+def f6_add(x, y):
+    return fp.add(x, y)
+
+
+def f6_sub(x, y):
+    return fp.sub(x, y)
+
+
+def f6_neg(x):
+    return fp.neg(x)
+
+
+def f6_mul(x, y):
+    """Schoolbook over Fp2 with v^3 = xi folding (oracle Fq6.__mul__)."""
+    a0, a1, a2 = f6_c(x, 0), f6_c(x, 1), f6_c(x, 2)
+    b0, b1, b2 = f6_c(y, 0), f6_c(y, 1), f6_c(y, 2)
+    t0 = fp2.mul(a0, b0)
+    t1 = fp2.add(fp2.mul(a0, b1), fp2.mul(a1, b0))
+    t2 = fp2.add(fp2.add(fp2.mul(a0, b2), fp2.mul(a1, b1)), fp2.mul(a2, b0))
+    t3 = fp2.add(fp2.mul(a1, b2), fp2.mul(a2, b1))
+    t4 = fp2.mul(a2, b2)
+    return f6_pack(
+        fp2.add(t0, fp2.mul_by_u_plus_1(t3)),
+        fp2.add(t1, fp2.mul_by_u_plus_1(t4)),
+        t2,
+    )
+
+
+def f6_sq(x):
+    return f6_mul(x, x)
+
+
+def f6_scale(x, k):
+    """Multiply every Fp2 coefficient by the fp2 element ``k``."""
+    return f6_pack(
+        fp2.mul(f6_c(x, 0), k), fp2.mul(f6_c(x, 1), k), fp2.mul(f6_c(x, 2), k)
+    )
+
+
+def f6_mul_by_v(x):
+    """(c0, c1, c2) -> (xi*c2, c0, c1)."""
+    return f6_pack(fp2.mul_by_u_plus_1(f6_c(x, 2)), f6_c(x, 0), f6_c(x, 1))
+
+
+def f6_inv(x):
+    c0, c1, c2 = f6_c(x, 0), f6_c(x, 1), f6_c(x, 2)
+    t0 = fp2.sub(fp2.sq(c0), fp2.mul_by_u_plus_1(fp2.mul(c1, c2)))
+    t1 = fp2.sub(fp2.mul_by_u_plus_1(fp2.sq(c2)), fp2.mul(c0, c1))
+    t2 = fp2.sub(fp2.sq(c1), fp2.mul(c0, c2))
+    den = fp2.add(
+        fp2.mul(c0, t0),
+        fp2.mul_by_u_plus_1(fp2.add(fp2.mul(c2, t1), fp2.mul(c1, t2))),
+    )
+    d = fp2.inv(den)
+    return f6_pack(fp2.mul(t0, d), fp2.mul(t1, d), fp2.mul(t2, d))
+
+
+# ---------------------------------------------------------------------------
+# Fp12
+# ---------------------------------------------------------------------------
+
+def pack(c0, c1):
+    return jnp.stack([c0, c1], axis=-4)
+
+
+def c0(x):
+    return x[..., 0, :, :, :]
+
+
+def c1(x):
+    return x[..., 1, :, :, :]
+
+
+def zeros(shape=()):
+    return jnp.zeros((*shape, 2, 3, 2, fp.NL), jnp.int32)
+
+
+def ones(shape=()):
+    return pack(f6_ones(shape), f6_zeros(shape))
+
+
+def add(x, y):
+    return fp.add(x, y)
+
+
+def sub(x, y):
+    return fp.sub(x, y)
+
+
+def neg(x):
+    return fp.neg(x)
+
+
+def mul(x, y):
+    a0, a1 = c0(x), c1(x)
+    b0, b1 = c0(y), c1(y)
+    t0 = f6_mul(a0, b0)
+    t1 = f6_mul(a1, b1)
+    # Karatsuba middle: (a0+a1)(b0+b1) - t0 - t1
+    m = f6_mul(f6_add(a0, a1), f6_add(b0, b1))
+    return pack(
+        f6_add(t0, f6_mul_by_v(t1)),
+        f6_sub(f6_sub(m, t0), t1),
+    )
+
+
+def sq(x):
+    return mul(x, x)
+
+
+def conjugate(x):
+    """x^(p^6): negate the w component. Inverse of unitary elements."""
+    return pack(c0(x), f6_neg(c1(x)))
+
+
+def inv(x):
+    a, b = c0(x), c1(x)
+    d = f6_inv(f6_sub(f6_sq(a), f6_mul_by_v(f6_sq(b))))
+    return pack(f6_mul(a, d), f6_neg(f6_mul(b, d)))
+
+
+def select(mask, a, b):
+    return jnp.where(mask[..., None, None, None, None], a, b)
+
+
+def canonical(x):
+    return fp.canonical(x)
+
+
+def is_one(x):
+    one = jnp.broadcast_to(ones(), x.shape)
+    return jnp.all(canonical(x) == canonical(one), axis=(-1, -2, -3, -4))
+
+
+def eq(x, y):
+    return jnp.all(canonical(x) == canonical(y), axis=(-1, -2, -3, -4))
+
+
+# Frobenius gamma constants (public, derived from xi = 1+u).
+_G6_1 = (GAMMA6_1.c0.n, GAMMA6_1.c1.n)
+_G6_2 = (GAMMA6_2.c0.n, GAMMA6_2.c1.n)
+_G12 = (GAMMA12.c0.n, GAMMA12.c1.n)
+
+
+def frobenius(x):
+    """x -> x^p (oracle Fq12.frobenius)."""
+    g61 = fp2.const(*_G6_1)
+    g62 = fp2.const(*_G6_2)
+    g12 = fp2.const(*_G12)
+
+    def frob6(a):
+        return f6_pack(
+            fp2.conjugate(f6_c(a, 0)),
+            fp2.mul(fp2.conjugate(f6_c(a, 1)), g61),
+            fp2.mul(fp2.conjugate(f6_c(a, 2)), g62),
+        )
+
+    fa = frob6(c0(x))
+    fb = f6_scale(frob6(c1(x)), g12)
+    return pack(fa, fb)
+
+
+def frobenius_n(x, n: int):
+    for _ in range(n):
+        x = frobenius(x)
+    return x
+
+
+def pow_const(x, e: int):
+    """x**e for fixed non-negative e; e == 0 -> one. Negative exponents are
+    the caller's job (conjugate for unitary elements, inv otherwise)."""
+    assert e >= 0
+    if e == 0:
+        return jnp.broadcast_to(ones(), x.shape).astype(jnp.int32)
+    return fp.square_multiply(x, e, sq, mul, select)
+
+
+def from_fp2(a):
+    """Embed an fp2 element into Fp12 (constant coefficient)."""
+    shape = a.shape[:-2]
+    out = zeros(shape)
+    return out.at[..., 0, 0, :, :].set(a)

@@ -2,22 +2,42 @@
 
 Tests run on a virtual 8-device CPU mesh so multi-chip sharding logic is
 exercised without TPU hardware (the driver separately dry-runs the
-multi-chip path; benches run on the real chip). Must be set before JAX is
-imported anywhere.
+multi-chip path; benches run on the real chip).
+
+The image pre-imports JAX via a sitecustomize hook with
+``JAX_PLATFORMS=axon`` (the real-TPU tunnel), so environment variables are
+already consumed by the time any conftest runs. Forcing CPU therefore goes
+through ``jax.config`` — valid until the first backend initialization —
+plus ``XLA_FLAGS`` (read at CPU-client creation, which has not happened at
+import time).
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+# Persistent compilation cache: scans/pairing graphs are large; caching
+# makes repeat test runs cheap.
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 import random  # noqa: E402
 
 import pytest  # noqa: E402
+
+assert jax.devices()[0].platform == "cpu", "tests must run on the CPU mesh"
+assert len(jax.devices()) == 8, "expected the virtual 8-device CPU mesh"
 
 
 @pytest.fixture

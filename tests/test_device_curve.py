@@ -1,0 +1,130 @@
+"""Device Jacobian curve ops vs the pure-Python affine oracle."""
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.crypto.cpu.curve import (
+    G1Point,
+    G2Point,
+    g1_generator,
+    g2_generator,
+)
+from lighthouse_tpu.crypto.device import curve, fp, fp2
+
+
+def _g1_points(rng, n):
+    g = g1_generator()
+    return [g.mul(rng.randrange(1, 1 << 64)) for _ in range(n)]
+
+
+def _g2_points(rng, n):
+    g = g2_generator()
+    return [g.mul(rng.randrange(1, 1 << 64)) for _ in range(n)]
+
+
+def _dev_g1(points):
+    xy, inf = curve.pack_g1(points)
+    return curve.from_affine(fp, xy[:, 0], xy[:, 1], inf)
+
+
+def _dev_g2(points):
+    xy, inf = curve.pack_g2(points)
+    return curve.from_affine(fp2, xy[:, 0], xy[:, 1], inf)
+
+
+def _host_g1(pt):
+    x, y, inf = curve.to_affine(fp, pt)
+    return curve.unpack_g1(np.stack([np.asarray(x), np.asarray(y)], 1), inf)
+
+
+def _host_g2(pt):
+    x, y, inf = curve.to_affine(fp2, pt)
+    return curve.unpack_g2(np.stack([np.asarray(x), np.asarray(y)], 1), inf)
+
+
+@pytest.mark.parametrize("group", ["g1", "g2"])
+def test_dbl_add_roundtrip(rng, group):
+    if group == "g1":
+        pts = _g1_points(rng, 3) + [G1Point.infinity()]
+        F, dev, host = fp, _dev_g1, _host_g1
+    else:
+        pts = _g2_points(rng, 3) + [G2Point.infinity()]
+        F, dev, host = fp2, _dev_g2, _host_g2
+    P = dev(pts)
+    assert host(curve.dbl(F, P)) == [p.double() for p in pts]
+    # pairwise add against a rotation (includes x + inf)
+    rot = pts[1:] + pts[:1]
+    Q = dev(rot)
+    assert host(curve.add(F, P, Q)) == [a + b for a, b in zip(pts, rot)]
+
+
+@pytest.mark.parametrize("group", ["g1", "g2"])
+def test_add_edge_cases(rng, group):
+    """P+P (doubling lane), P + (-P) (infinity lane), inf + inf."""
+    if group == "g1":
+        p = _g1_points(rng, 1)[0]
+        F, dev, host, inf = fp, _dev_g1, _host_g1, G1Point.infinity()
+    else:
+        p = _g2_points(rng, 1)[0]
+        F, dev, host, inf = fp2, _dev_g2, _host_g2, G2Point.infinity()
+    lhs = dev([p, p, inf, p])
+    rhs = dev([p, -p, inf, inf])
+    got = host(curve.add(F, lhs, rhs))
+    assert got == [p.double(), inf, inf, p]
+
+
+@pytest.mark.parametrize("group", ["g1", "g2"])
+def test_scalar_mul_bits(rng, group):
+    if group == "g1":
+        pts = _g1_points(rng, 4)
+        F, dev, host = fp, _dev_g1, _host_g1
+    else:
+        pts = _g2_points(rng, 4)
+        F, dev, host = fp2, _dev_g2, _host_g2
+    ks = [rng.randrange(0, 1 << 64) for _ in pts]
+    bits = np.stack(
+        [np.array([(k >> (63 - i)) & 1 for i in range(64)], np.int32) for k in ks]
+    )
+    got = host(curve.scalar_mul_bits(F, dev(pts), bits))
+    assert got == [p.mul(k) for p, k in zip(pts, ks)]
+
+
+def test_scalar_mul_const(rng):
+    pts = _g1_points(rng, 3)
+    k = rng.randrange(1 << 63, 1 << 64)
+    got = _host_g1(curve.scalar_mul_const(fp, _dev_g1(pts), k))
+    assert got == [p.mul(k) for p in pts]
+    # k = 0 -> infinity
+    got0 = _host_g1(curve.scalar_mul_const(fp, _dev_g1(pts), 0))
+    assert all(p.is_infinity() for p in got0)
+
+
+@pytest.mark.parametrize("group", ["g1", "g2"])
+def test_sum_points(rng, group):
+    if group == "g1":
+        pts = _g1_points(rng, 5) + [G1Point.infinity()]
+        # include a duplicate to force a doubling lane inside the tree
+        pts.append(pts[0])
+        F, dev, host, acc0 = fp, _dev_g1, _host_g1, G1Point.infinity()
+    else:
+        pts = _g2_points(rng, 5) + [G2Point.infinity()]
+        pts.append(pts[0])
+        F, dev, host, acc0 = fp2, _dev_g2, _host_g2, G2Point.infinity()
+    s = curve.sum_points(F, dev(pts))
+    expect = acc0
+    for p in pts:
+        expect = expect + p
+    x, y, inf = curve.to_affine(F, s)
+    unpack = curve.unpack_g1 if group == "g1" else curve.unpack_g2
+    got = unpack(np.stack([np.asarray(x), np.asarray(y)])[None], np.asarray(inf)[None])
+    assert got == [expect]
+
+
+def test_eq_projective(rng):
+    pts = _g1_points(rng, 2)
+    P = _dev_g1(pts)
+    # 2P computed two ways: dbl vs add(P, P) -> different Z, same point
+    a = curve.dbl(fp, P)
+    b = curve.add(fp, P, P)
+    assert list(np.asarray(curve.eq(fp, a, b))) == [True, True]
+    assert list(np.asarray(curve.eq(fp, a, P))) == [False, False]
