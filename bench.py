@@ -1,0 +1,83 @@
+"""North-star benchmark: BLS signature-set verifications/sec on one chip.
+
+Workload shape follows BASELINE.md config #3 (gossip aggregate batch): each
+aggregate attestation costs three signature sets (selection proof,
+aggregator signature, aggregate attestation signature over the committee —
+reference: ``beacon_node/beacon_chain/src/attestation_verification/batch.rs:77-107``).
+Here: B sets per device batch with a mix of single-pubkey and
+committee-aggregation (multi-pubkey) sets, pre-hashed messages (message
+de-dup mirrors the 64-committees-per-slot structure).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where
+``vs_baseline`` is measured against the 50k aggregate-verifications/sec
+target from BASELINE.json (an aggregate = 3 sets).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.crypto.device.bls import pack_signature_sets, verify_batch
+
+# Batch geometry: 64 aggregates -> 192 sets (2/3 single-pubkey, 1/3
+# committee sets with COMMITTEE pubkeys), padded to the (256, 16) bucket.
+N_AGG = 64
+COMMITTEE = 16
+B_PAD = 256
+K_PAD = 16
+TARGET_AGG_PER_SEC = 50_000.0
+
+
+def build_batch():
+    sets = []
+    n_msgs = 8  # distinct AttestationData roots in flight
+    sks = [bls.SecretKey(1_000 + i) for i in range(COMMITTEE)]
+    pks = [sk.public_key().point for sk in sks]
+    msgs = [bytes([m + 1]) * 32 for m in range(n_msgs)]
+    sigs = [[sk.sign(m) for sk in sks] for m in msgs]
+    for i in range(N_AGG):
+        m = i % n_msgs
+        # selection proof + aggregator signature (single-pubkey sets)
+        sets.append((sigs[m][0].point, [pks[0]], msgs[m]))
+        sets.append((sigs[m][1].point, [pks[1]], msgs[m]))
+        # aggregate attestation signature (committee set)
+        agg = bls.AggregateSignature.infinity()
+        for s in sigs[m]:
+            agg.add_assign(s)
+        sets.append((agg.point, pks, msgs[m]))
+    return pack_signature_sets(sets, pad_b=B_PAD, pad_k=K_PAD), len(sets)
+
+
+def main() -> None:
+    args, n_sets = build_batch()
+    # Warm-up: compile (first TPU compile is slow; cached afterwards).
+    ok = verify_batch(*args)
+    assert bool(ok) is True, "benchmark batch must verify"
+
+    reps = 3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = verify_batch(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / reps
+
+    sets_per_sec = n_sets / dt
+    agg_per_sec = N_AGG / dt
+    print(
+        json.dumps(
+            {
+                "metric": "bls_sigset_verifications_per_sec_per_chip",
+                "value": round(sets_per_sec, 2),
+                "unit": "sets/s",
+                "vs_baseline": round(agg_per_sec / TARGET_AGG_PER_SEC, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -79,6 +79,8 @@ def register(name: str, factory: Callable[[], object]) -> None:
 def set_backend(name: str) -> None:
     global _active, _active_name
     with _lock:
+        if name not in _REGISTRY and name == "tpu":
+            from . import device  # noqa: F401  (registers "tpu")
         if name not in _REGISTRY:
             raise KeyError(f"unknown BLS backend {name!r}; have {sorted(_REGISTRY)}")
         _active = _REGISTRY[name]()

@@ -13,3 +13,14 @@ so the whole tower/curve/pairing stack is batched by construction — no
 ``vmap`` required. Bounds guaranteeing no int32 overflow are checked by
 interval arithmetic at import time (see ``fp.py``).
 """
+
+from .. import backend as _backend
+
+
+def _make_tpu_backend():
+    from . import bls as _bls
+
+    return _bls.TpuBackend()
+
+
+_backend.register("tpu", _make_tpu_backend)

@@ -1,0 +1,307 @@
+"""TPU-native batched BLS signature-set verification.
+
+This is the device half of the north-star seam: the reference's
+``verify_signature_sets`` (``/root/reference/crypto/bls/src/impls/blst.rs:36-119``)
+re-designed as one fixed-shape, branch-free JAX program:
+
+    per set i (batch lane i):
+      agg_pk_i = sum of the set's pubkeys          (masked Jacobian sum)
+      sig subgroup check: psi(sig) == [x] sig      (64-bit scan)
+      r_i agg_pk_i, r_i sig_i                      (64-bit random scalars)
+    sig_acc = sum_i r_i sig_i                      (log-depth tree)
+    ok = FE( prod_i ML(r_i agg_pk_i, H(m_i)) * ML(-g1, sig_acc) ) == 1
+         AND all subgroup checks
+
+The batch dimension is the data-parallel axis the reference spreads over
+rayon cores (``block_signature_verifier.rs:374-382``); here it is the
+device batch axis, shardable over chips via ``jax.sharding`` (see
+``parallel/``).
+
+Shapes (B sets, K max pubkeys/set):
+  pk_xy  int32[B, K, 2, 32]   pk_mask bool[B, K]
+  sig_xy int32[B, 2, 2, 32]   (x, y) each Fp2
+  msg_xy int32[B, 2, 2, 32]   H(m) on G2 (hash-to-curve)
+  rand   int32[B, 64]          MSB-first nonzero 64-bit scalars
+  set_mask bool[B]             False = padding lane (must not affect result)
+
+Host-side padding/bucketing, randomness, and the reference's edge
+semantics (empty batch / empty set / infinity signature => False) live in
+:class:`TpuBackend` below.
+"""
+
+from __future__ import annotations
+
+import secrets
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..params import DST, G1_X, G1_Y, P, R, X
+from ..cpu.pairing import PSI_CX, PSI_CY
+from ..cpu.hash_to_curve import hash_to_g2
+from . import curve, fp, fp2, pairing, tower
+from .pairing import X_ABS
+
+# psi constants (public, derived from xi; see cpu/pairing.py:22-27).
+_PSI_CX = (PSI_CX.c0.n, PSI_CX.c1.n)
+_PSI_CY = (PSI_CY.c0.n, PSI_CY.c1.n)
+
+# -g1 generator, embedded as constants.
+_NEG_G1 = (G1_X, (P - G1_Y) % P)
+
+
+def _psi_jacobian(pt):
+    """Untwist-Frobenius-twist endomorphism in Jacobian coords:
+    (X, Y, Z) -> (conj(X) CX, conj(Y) CY, conj(Z))."""
+    x, y, z = pt
+    return (
+        fp2.mul(fp2.conjugate(x), fp2.const(*_PSI_CX)),
+        fp2.mul(fp2.conjugate(y), fp2.const(*_PSI_CY)),
+        fp2.conjugate(z),
+    )
+
+
+def g2_in_subgroup(pt):
+    """Scott's membership test for G2 on BLS12-381: Q in G2 iff
+    psi(Q) == [x]Q (eigenvalue x of psi on the r-torsion; verified against
+    the full [r]Q == O check in tests). Infinity passes."""
+    xq = curve.scalar_mul_const(fp2, pt, X_ABS)
+    xq = curve.neg(fp2, xq)  # x < 0
+    return curve.eq(fp2, _psi_jacobian(pt), xq) | curve.is_infinity(fp2, pt)
+
+
+def _bits64(r):
+    """int32[..., 2] (hi, lo) -> MSB-first bits int32[..., 64]."""
+    hi, lo = r[..., 0], r[..., 1]
+    shifts = jnp.arange(31, -1, -1, dtype=jnp.int32)
+    hb = (hi[..., None] >> shifts) & 1
+    lb = (lo[..., None] >> shifts) & 1
+    return jnp.concatenate([hb, lb], axis=-1)
+
+
+def verify_batch_fn(pk_xy, pk_mask, sig_xy, msg_xy, rand_bits, set_mask):
+    """The one-shot device program. Returns a scalar bool: True iff every
+    real lane's set verifies (random-linear-combination soundness)."""
+    B = pk_xy.shape[0]
+
+    # --- aggregate pubkeys per set (masked sum over the K axis) ---------
+    pk_pts = curve.from_affine(
+        fp, pk_xy[..., 0, :], pk_xy[..., 1, :], ~pk_mask
+    )
+    agg_pk = curve.sum_points(fp, pk_pts, axis=1)  # [B] G1 Jacobian
+
+    # --- signatures: subgroup check + random scaling --------------------
+    sig_pts = curve.from_affine(fp2, sig_xy[..., 0, :, :], sig_xy[..., 1, :, :])
+    sub_ok = g2_in_subgroup(sig_pts) | ~set_mask
+    subgroup_ok = jnp.all(sub_ok)
+
+    bits = _bits64(rand_bits) if rand_bits.shape[-1] == 2 else rand_bits
+    r_pk = curve.scalar_mul_bits(fp, agg_pk, bits)       # [B] G1
+    r_sig = curve.scalar_mul_bits(fp2, sig_pts, bits)    # [B] G2
+
+    # padding lanes must not contribute to the signature accumulator
+    inf2 = curve.infinity(fp2)
+    r_sig = curve.select(
+        fp2, set_mask, r_sig,
+        tuple(jnp.broadcast_to(c, o.shape) for c, o in zip(inf2, r_sig)),
+    )
+    sig_acc = curve.sum_points(fp2, r_sig, axis=0)       # single G2
+
+    # --- assemble the multi-pairing: B lanes + the accumulator lane -----
+    pk_x, pk_y, pk_inf = curve.to_affine(fp, r_pk)
+    # padding lanes: force G1 point to infinity => Miller value 1
+    pk_inf = pk_inf | ~set_mask
+
+    g1_x = jnp.concatenate([pk_x, fp.const(_NEG_G1[0])[None]], axis=0)
+    g1_y = jnp.concatenate([pk_y, fp.const(_NEG_G1[1])[None]], axis=0)
+    g1_inf = jnp.concatenate([pk_inf, jnp.zeros((1,), bool)], axis=0)
+
+    acc_x, acc_y, acc_inf = curve.to_affine(fp2, sig_acc)
+    g2_x = jnp.concatenate([msg_xy[:, 0], acc_x[None]], axis=0)
+    g2_y = jnp.concatenate([msg_xy[:, 1], acc_y[None]], axis=0)
+    g2_inf = jnp.concatenate([jnp.zeros((B,), bool), acc_inf[None]], axis=0)
+
+    out = pairing.multi_pairing((g1_x, g1_y, g1_inf), (g2_x, g2_y, g2_inf))
+    pairing_ok = tower.is_one(out)
+
+    # a real lane whose aggregate pubkey degenerated to infinity (e.g. sum
+    # of pubkeys cancels) must fail rather than silently contribute 1
+    agg_inf_bad = jnp.any(curve.is_infinity(fp, agg_pk) & set_mask)
+
+    return pairing_ok & subgroup_ok & ~agg_inf_bad
+
+
+verify_batch = jax.jit(verify_batch_fn)
+
+
+# ---------------------------------------------------------------------------
+# Host backend: padding, bucketing, randomness, reference edge semantics
+# ---------------------------------------------------------------------------
+
+def _round_up(n: int, choices=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)) -> int:
+    for c in choices:
+        if n <= c:
+            return c
+    return ((n + 1023) // 1024) * 1024
+
+
+def _rand_scalar_words() -> tuple[int, int]:
+    while True:
+        r = secrets.randbits(64)
+        if r:
+            return (r >> 32) & 0xFFFFFFFF, r & 0xFFFFFFFF
+
+
+def pack_signature_sets(sets, pad_b: int | None = None, pad_k: int | None = None):
+    """Host-side batch assembly: (sig_point, [pk_points], message) triples ->
+    the fixed-shape device arrays of :func:`verify_batch_fn`. Sets must be
+    pre-screened (non-empty, non-infinity signature). Shapes are padded to
+    bucket sizes to bound jit recompiles."""
+    sets = list(sets)
+    B = pad_b or _round_up(len(sets))
+    K = pad_k or _round_up(max(len(pks) for _, pks, _ in sets))
+
+    pk_xy = np.zeros((B, K, 2, fp.NL), np.int32)
+    pk_mask = np.zeros((B, K), bool)
+    sig_xy = np.zeros((B, 2, 2, fp.NL), np.int32)
+    msg_xy = np.zeros((B, 2, 2, fp.NL), np.int32)
+    rand = np.zeros((B, 2), np.int32)
+    set_mask = np.zeros((B,), bool)
+
+    msg_cache: dict[bytes, np.ndarray] = {}
+    for i, (sig, pks, msg) in enumerate(sets):
+        xy, _ = curve.pack_g1(pks)
+        pk_xy[i, : len(pks)] = xy
+        pk_mask[i, : len(pks)] = True
+        sxy, _ = curve.pack_g2([sig])
+        sig_xy[i] = sxy[0]
+        hxy = msg_cache.get(msg)
+        if hxy is None:
+            hxy = curve.pack_g2([hash_to_g2(msg, DST)])[0][0]
+            msg_cache[msg] = hxy
+        msg_xy[i] = hxy
+        hi, lo = _rand_scalar_words()
+        rand[i] = (np.int32(np.uint32(hi)), np.int32(np.uint32(lo)))
+        set_mask[i] = True
+    # Padding lanes get a valid placeholder signature point (the real G2
+    # generator) so the subgroup check vectorizes uniformly; their
+    # contribution is masked out by set_mask.
+    if B > len(sets):
+        from ..cpu.curve import g2_generator
+
+        gxy, _ = curve.pack_g2([g2_generator()])
+        sig_xy[len(sets):] = gxy[0]
+        msg_xy[len(sets):] = gxy[0]
+
+    return (
+        jnp.asarray(pk_xy),
+        jnp.asarray(pk_mask),
+        jnp.asarray(sig_xy),
+        jnp.asarray(msg_xy),
+        jnp.asarray(rand),
+        jnp.asarray(set_mask),
+    )
+
+
+class TpuBackend:
+    """Runtime backend ``"tpu"`` (see crypto/backend.py). Presents the same
+    protocol as the CPU oracle backend; internally packs fixed-shape
+    batches and calls the jitted device program (compile cache keyed on
+    padded (B, K) bucket shape)."""
+
+    name = "tpu"
+
+    # -- batch verification (the hot path) -------------------------------
+
+    def verify_signature_sets(self, sets) -> bool:
+        sets = list(sets)
+        if not sets:
+            return False
+        for sig, pks, _msg in sets:
+            if sig.is_infinity() or not pks:
+                return False
+            if any(pk.is_infinity() for pk in pks):
+                return False
+        out = verify_batch(*pack_signature_sets(sets))
+        return bool(out)
+
+    # -- single-set entry points (same device program, B=1 semantics) ----
+
+    def verify(self, pk, message, sig) -> bool:
+        if pk.is_infinity() or not pk.in_subgroup():
+            return False
+        return self._verify_one(sig, [pk], message, aggregate=False)
+
+    def fast_aggregate_verify(self, pks, message, sig) -> bool:
+        pks = list(pks)
+        if not pks:
+            return False
+        # Parity with the CPU backend: the aggregated pubkey must be a
+        # non-infinity subgroup point (cpu/bls.py fast_aggregate_verify ->
+        # verify pk checks).
+        agg = pks[0]
+        for p in pks[1:]:
+            agg = agg + p
+        if agg.is_infinity() or not agg.in_subgroup():
+            return False
+        return self._verify_one(sig, pks, message, aggregate=True)
+
+    def aggregate_verify(self, pks, messages, sig) -> bool:
+        """One signature over per-pubkey messages: prod e(pk_i, H(m_i)) *
+        e(-g1, sig) == 1 with a subgroup-checked signature."""
+        pks, messages = list(pks), list(messages)
+        if not pks or len(pks) != len(messages):
+            return False
+        # Parity with the CPU backend: every pubkey non-infinity + subgroup.
+        if any(pk.is_infinity() or not pk.in_subgroup() for pk in pks):
+            return False
+        n = len(pks)
+        Bn = _round_up(n)
+        pk_xy = np.zeros((Bn, 2, fp.NL), np.int32)
+        pk_inf = np.ones((Bn,), bool)
+        msg_xy = np.zeros((Bn, 2, 2, fp.NL), np.int32)
+        msg_inf = np.ones((Bn,), bool)
+        xy, _ = curve.pack_g1(pks)
+        pk_xy[:n] = xy
+        pk_inf[:n] = False
+        hs = [hash_to_g2(m, DST) for m in messages]
+        hxy, _ = curve.pack_g2(hs)
+        msg_xy[:n] = hxy
+        msg_inf[:n] = False
+
+        sxy, s_inf = curve.pack_g2([sig])
+        if s_inf[0]:
+            return False
+        return bool(
+            _aggregate_verify_device(
+                jnp.asarray(pk_xy),
+                jnp.asarray(pk_inf),
+                jnp.asarray(msg_xy),
+                jnp.asarray(msg_inf),
+                jnp.asarray(sxy[0]),
+            )
+        )
+
+    def _verify_one(self, sig, pks, message, aggregate: bool) -> bool:
+        if sig.is_infinity():
+            return False
+        return self.verify_signature_sets([(sig, pks, message)])
+
+
+@jax.jit
+def _aggregate_verify_device(pk_xy, pk_inf, msg_xy, msg_inf, sig_xy):
+    sig_pt = curve.from_affine(fp2, sig_xy[0], sig_xy[1])
+    sub_ok = g2_in_subgroup(sig_pt)
+
+    g1_x = jnp.concatenate([pk_xy[:, 0], fp.const(_NEG_G1[0])[None]], axis=0)
+    g1_y = jnp.concatenate([pk_xy[:, 1], fp.const(_NEG_G1[1])[None]], axis=0)
+    g1_inf = jnp.concatenate([pk_inf, jnp.zeros((1,), bool)], axis=0)
+    sx, sy, sinf = curve.to_affine(fp2, sig_pt)
+    g2_x = jnp.concatenate([msg_xy[:, 0], sx[None]], axis=0)
+    g2_y = jnp.concatenate([msg_xy[:, 1], sy[None]], axis=0)
+    g2_inf = jnp.concatenate([msg_inf, sinf[None]], axis=0)
+
+    out = pairing.multi_pairing((g1_x, g1_y, g1_inf), (g2_x, g2_y, g2_inf))
+    return tower.is_one(out) & sub_ok

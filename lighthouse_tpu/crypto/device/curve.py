@@ -163,31 +163,44 @@ def _batch_shape(F, x):
     return x.shape[: x.ndim - F.ELEM_NDIM]
 
 
-def sum_points(F, pt, axis: int = 0):
-    """Tree-reduce a batch of points along a leading axis with the unified
-    group law (log-depth: pads to a power of two with infinity)."""
-    x, y, z = pt
-    n = x.shape[axis]
+def tree_reduce(x, axis: int, combine, identity):
+    """Log-depth reduction of a pytree of arrays along ``axis``: pad to a
+    power of two with (broadcast) ``identity`` leaves, then halve with
+    ``combine``. Shared by point summation and the Miller-value product."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(x)
+    n = leaves[0].shape[axis]
     m = 1
     while m < n:
         m *= 2
     if m != n:
-        pad_shape = list(x.shape)
-        pad_shape[axis] = m - n
-        infs = infinity(F, ())
-        padded = []
-        for c, i in zip((x, y, z), infs):
-            ishape = list(pad_shape)
-            pad = jnp.broadcast_to(i, tuple(ishape))
-            padded.append(jnp.concatenate([c, pad], axis=axis))
-        x, y, z = padded
-    pt = (x, y, z)
-    while pt[0].shape[axis] > 1:
-        half = pt[0].shape[axis] // 2
-        lo = tuple(lax.slice_in_dim(c, 0, half, axis=axis) for c in pt)
-        hi = tuple(lax.slice_in_dim(c, half, 2 * half, axis=axis) for c in pt)
-        pt = add(F, lo, hi)
-    return tuple(jnp.squeeze(c, axis=axis) for c in pt)
+
+        def pad_leaf(c, i):
+            shape = list(c.shape)
+            shape[axis] = m - n
+            return jnp.concatenate(
+                [c, jnp.broadcast_to(i, tuple(shape)).astype(c.dtype)], axis=axis
+            )
+
+        x = jax.tree_util.tree_map(pad_leaf, x, identity)
+    while jax.tree_util.tree_leaves(x)[0].shape[axis] > 1:
+        half = jax.tree_util.tree_leaves(x)[0].shape[axis] // 2
+        lo = jax.tree_util.tree_map(
+            lambda c: lax.slice_in_dim(c, 0, half, axis=axis), x
+        )
+        hi = jax.tree_util.tree_map(
+            lambda c: lax.slice_in_dim(c, half, 2 * half, axis=axis), x
+        )
+        x = combine(lo, hi)
+    return jax.tree_util.tree_map(lambda c: jnp.squeeze(c, axis=axis), x)
+
+
+def sum_points(F, pt, axis: int = 0):
+    """Tree-reduce a batch of points with the unified group law."""
+    return tree_reduce(
+        pt, axis, lambda a, b: add(F, a, b), infinity(F)
+    )
 
 
 # ---------------------------------------------------------------------------

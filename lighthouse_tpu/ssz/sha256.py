@@ -1,0 +1,41 @@
+"""Hashing layer: batched SHA-256 over 64-byte blocks.
+
+The analogue of the reference's ``crypto/eth2_hashing`` (runtime dispatch
+between ring and SHA-NI — ``src/lib.rs:87-177``): one seam,
+``hash_pairs``, through which ALL merkleization flows, so the backend can
+be swapped (hashlib loop now; C++ batched SHA-NI or a device kernel later)
+without touching tree-hash logic.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+
+def hash_bytes(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
+
+
+def hash32_concat(a: bytes, b: bytes) -> bytes:
+    return hashlib.sha256(a + b).digest()
+
+
+def hash_pairs(pairs: np.ndarray) -> np.ndarray:
+    """uint8[n, 64] -> uint8[n, 32]: SHA-256 of each 64-byte row.
+
+    The merkleization hot loop. Current backend: hashlib (OpenSSL SHA-NI)
+    per row — already native speed per hash; the batch interface is what
+    lets a vectorized backend slot in.
+    """
+    out = np.empty((pairs.shape[0], 32), np.uint8)
+    for i in range(pairs.shape[0]):
+        out[i] = np.frombuffer(hashlib.sha256(pairs[i].tobytes()).digest(), np.uint8)
+    return out
+
+
+# Zero-subtree hashes: ZERO_HASHES[d] = root of an all-zero depth-d tree.
+ZERO_HASHES = [bytes(32)]
+for _ in range(64):
+    ZERO_HASHES.append(hash32_concat(ZERO_HASHES[-1], ZERO_HASHES[-1]))

@@ -1,0 +1,7 @@
+"""Test rigs (reference: ``beacon_node/beacon_chain/src/test_utils.rs``
+``BeaconChainHarness``, ``testing/node_test_rig``): deterministic interop
+validators driving real state transitions with real BLS signatures."""
+
+from .harness import StateHarness
+
+__all__ = ["StateHarness"]

@@ -1,0 +1,105 @@
+"""Device Fp6/Fp12 tower and pairing vs the pure-Python oracle."""
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.crypto.cpu import pairing as cpu_pairing
+from lighthouse_tpu.crypto.cpu.curve import G1Point, G2Point, g1_generator, g2_generator
+from lighthouse_tpu.crypto.cpu.fields import Fq, Fq2, Fq6, Fq12
+from lighthouse_tpu.crypto.params import P
+from lighthouse_tpu.crypto.device import curve, fp, fp2, pairing, tower
+
+import jax.numpy as jnp
+
+
+def _rand_f12(rng, n):
+    def f2():
+        return Fq2.from_ints(rng.randrange(P), rng.randrange(P))
+
+    def f6():
+        return Fq6(f2(), f2(), f2())
+
+    return [Fq12(f6(), f6()) for _ in range(n)]
+
+
+def _g1_aff(points):
+    xy, inf = curve.pack_g1(points)
+    return jnp.asarray(xy[:, 0]), jnp.asarray(xy[:, 1]), jnp.asarray(inf)
+
+
+def def_g2_aff(points):
+    xy, inf = curve.pack_g2(points)
+    return jnp.asarray(xy[:, 0]), jnp.asarray(xy[:, 1]), jnp.asarray(inf)
+
+
+def test_tower_mul_inv_frobenius(rng):
+    vals = _rand_f12(rng, 4)
+    other = _rand_f12(rng, 4)
+    A = jnp.asarray(tower.pack_f12(vals))
+    B = jnp.asarray(tower.pack_f12(other))
+    assert tower.unpack_f12(tower.mul(A, B)) == [a * b for a, b in zip(vals, other)]
+    assert tower.unpack_f12(tower.sq(A)) == [a * a for a in vals]
+    assert tower.unpack_f12(tower.add(A, B)) == [a + b for a, b in zip(vals, other)]
+    assert tower.unpack_f12(tower.conjugate(A)) == [a.conjugate() for a in vals]
+    assert tower.unpack_f12(tower.inv(A)) == [a.inverse() for a in vals]
+    assert tower.unpack_f12(tower.frobenius(A)) == [a.frobenius() for a in vals]
+    assert tower.unpack_f12(tower.frobenius_n(A, 2)) == [
+        a.frobenius_n(2) for a in vals
+    ]
+
+
+def test_tower_pow_is_one(rng):
+    vals = _rand_f12(rng, 2)
+    A = jnp.asarray(tower.pack_f12(vals))
+    e = rng.randrange(2, 1 << 40)
+    assert tower.unpack_f12(tower.pow_const(A, e)) == [a.pow(e) for a in vals]
+    ones = [Fq12.one(), vals[0]]
+    B = jnp.asarray(tower.pack_f12(ones))
+    assert list(np.asarray(tower.is_one(B))) == [True, False]
+
+
+def test_pairing_matches_oracle(rng):
+    """Device Miller values differ from the oracle's by Fp2 line scalings
+    (by design); the full pairing (after final exponentiation) must agree
+    bit-exactly."""
+    ps = [g1_generator().mul(rng.randrange(1, 1 << 32)) for _ in range(2)]
+    qs = [g2_generator().mul(rng.randrange(1, 1 << 32)) for _ in range(2)]
+    got = tower.unpack_f12(pairing.pairing(_g1_aff(ps), def_g2_aff(qs)))
+    expect = [cpu_pairing.pairing(p, q) for p, q in zip(ps, qs)]
+    assert got == expect
+
+
+def test_miller_loop_infinity_lanes(rng):
+    ps = [g1_generator(), G1Point.infinity(), g1_generator()]
+    qs = [g2_generator(), g2_generator(), G2Point.infinity()]
+    got = tower.unpack_f12(pairing.miller_loop(_g1_aff(ps), def_g2_aff(qs)))
+    assert got[1] == Fq12.one() and got[2] == Fq12.one()
+
+
+def test_final_exponentiation_matches_oracle(rng):
+    p = g1_generator().mul(7)
+    q = g2_generator().mul(11)
+    f_oracle = cpu_pairing.miller_loop(p, q)
+    F = jnp.asarray(tower.pack_f12([f_oracle]))
+    got = tower.unpack_f12(pairing.final_exponentiation(F))
+    assert got == [cpu_pairing.final_exponentiation(f_oracle)]
+
+
+def test_pairing_bilinearity_device(rng):
+    a, b = rng.randrange(2, 1 << 16), rng.randrange(2, 1 << 16)
+    g1, g2 = g1_generator(), g2_generator()
+    e1 = tower.unpack_f12(pairing.pairing(_g1_aff([g1.mul(a)]), def_g2_aff([g2.mul(b)])))
+    e2 = tower.unpack_f12(pairing.pairing(_g1_aff([g1.mul(b)]), def_g2_aff([g2.mul(a)])))
+    e3 = tower.unpack_f12(pairing.pairing(_g1_aff([g1.mul(a * b)]), def_g2_aff([g2])))
+    assert e1 == e2 == e3
+
+
+def test_multi_pairing_cancellation(rng):
+    """e(P, Q) * e(-P, Q) == 1 — the exact shape of a verification check."""
+    k = rng.randrange(2, 1 << 20)
+    p = g1_generator().mul(k)
+    q = g2_generator().mul(3)
+    out = pairing.multi_pairing(_g1_aff([p, -p]), def_g2_aff([q, q]))
+    assert bool(np.asarray(tower.is_one(out)))
+    out2 = pairing.multi_pairing(_g1_aff([p, -p]), def_g2_aff([q, q.double()]))
+    assert not bool(np.asarray(tower.is_one(out2)))

@@ -1,0 +1,128 @@
+"""Shuffling + state accessor tests (reference strategy: the shuffle is a
+pure function certified by structural properties + the single-index spec
+loop; committees partition the active set)."""
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.types import MINIMAL, FAR_FUTURE_EPOCH, types_for
+from lighthouse_tpu.state_transition import (
+    CommitteeCache,
+    compute_epoch_at_slot,
+    compute_proposer_index,
+    compute_shuffled_index,
+    get_active_validator_indices,
+    get_attesting_indices,
+    get_beacon_proposer_index,
+    get_committee_count_per_slot,
+    get_seed,
+    get_total_active_balance,
+    integer_squareroot,
+    shuffle_list,
+    unshuffle_list,
+)
+
+
+def test_integer_squareroot():
+    for n, want in [(0, 0), (1, 1), (3, 1), (4, 2), (26, 5), (2**64 - 1, 4294967295)]:
+        assert integer_squareroot(n) == want
+
+
+def test_shuffle_list_matches_single_index(rng):
+    seed = bytes(rng.randrange(256) for _ in range(32))
+    n, rounds = 100, 10
+    perm = shuffle_list(n, seed, rounds)
+    for i in [0, 1, 50, 99]:
+        assert perm[i] == compute_shuffled_index(i, n, seed, rounds)
+    # permutation property
+    assert sorted(perm.tolist()) == list(range(n))
+
+
+def test_unshuffle_is_inverse(rng):
+    seed = bytes(rng.randrange(256) for _ in range(32))
+    n, rounds = 321, 10
+    perm = shuffle_list(n, seed, rounds)
+    inv = unshuffle_list(n, seed, rounds)
+    assert np.array_equal(perm[inv], np.arange(n))
+    assert np.array_equal(inv[perm], np.arange(n))
+
+
+def _make_state(n_validators=64):
+    t = types_for(MINIMAL)
+    st = t.state["phase0"]()
+    st.slot = 16
+    st.validators = [
+        t.Validator(
+            pubkey=bytes([i % 256, i // 256]) + bytes(46),
+            effective_balance=32 * 10**9,
+            activation_epoch=0,
+            exit_epoch=FAR_FUTURE_EPOCH,
+            withdrawable_epoch=FAR_FUTURE_EPOCH,
+        )
+        for i in range(n_validators)
+    ]
+    st.balances = [32 * 10**9] * n_validators
+    st.randao_mixes = [bytes([i % 256]) * 32 for i in range(64)]
+    return t, st
+
+
+def test_committees_partition_active_set():
+    t, st = _make_state(64)
+    epoch = compute_epoch_at_slot(MINIMAL, st.slot)
+    cache = CommitteeCache(MINIMAL, st, epoch)
+    seen = []
+    for slot in range(
+        epoch * MINIMAL.SLOTS_PER_EPOCH, (epoch + 1) * MINIMAL.SLOTS_PER_EPOCH
+    ):
+        for idx in range(cache.committees_per_slot):
+            seen.extend(cache.committee(slot, idx).tolist())
+    assert sorted(seen) == get_active_validator_indices(st, epoch)
+
+
+def test_committee_count_scales():
+    t, st = _make_state(64)
+    assert get_committee_count_per_slot(MINIMAL, st, 2) == 2  # 64/8/4 = 2
+    t2, st2 = _make_state(8)
+    assert get_committee_count_per_slot(MINIMAL, st2, 2) == 1
+
+
+def test_proposer_index_deterministic_and_active():
+    t, st = _make_state(64)
+    p1 = get_beacon_proposer_index(MINIMAL, st)
+    p2 = get_beacon_proposer_index(MINIMAL, st)
+    assert p1 == p2
+    assert 0 <= p1 < 64
+    st.slot += 1
+    # overwhelmingly likely to differ across slots eventually; just check range
+    assert 0 <= get_beacon_proposer_index(MINIMAL, st) < 64
+
+
+def test_proposer_sampling_prefers_effective_balance():
+    t, st = _make_state(64)
+    # zero out everyone's balance except validator 7: sampling must pick 7
+    for i, v in enumerate(st.validators):
+        if i != 7:
+            v.effective_balance = 0
+    seed = b"\x07" * 32
+    idx = compute_proposer_index(
+        MINIMAL, st, get_active_validator_indices(st, 2), seed
+    )
+    assert idx == 7
+
+
+def test_attesting_indices_roundtrip():
+    t, st = _make_state(64)
+    epoch = compute_epoch_at_slot(MINIMAL, st.slot)
+    cache = CommitteeCache(MINIMAL, st, epoch)
+    committee = cache.committee(st.slot, 0)
+    bits = [i % 2 == 0 for i in range(len(committee))]
+    data = t.AttestationData(slot=st.slot, index=0)
+    got = get_attesting_indices(MINIMAL, st, data, bits)
+    assert got == sorted(int(v) for v, b in zip(committee, bits) if b)
+    with pytest.raises(ValueError):
+        get_attesting_indices(MINIMAL, st, data, bits + [True])
+
+
+def test_total_active_balance():
+    t, st = _make_state(10)
+    assert get_total_active_balance(MINIMAL, st) == 10 * 32 * 10**9

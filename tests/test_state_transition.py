@@ -1,0 +1,127 @@
+"""End-to-end state transition: interop genesis -> signed blocks ->
+epoch boundaries -> justification/finalization, per fork. The analogue of
+the reference's per-fork beacon-chain tests (``Makefile:117-129``) at the
+state-transition layer. Chain-mechanics tests use the fake-signing seam
+(the reference's ``fake_crypto`` pattern); dedicated tests use real BLS.
+"""
+
+import copy
+
+import pytest
+
+from lighthouse_tpu.ssz import hash_tree_root
+from lighthouse_tpu.state_transition import (
+    BlockProcessingError,
+    BlockSignatureAccumulator,
+    interop_genesis_state,
+    is_valid_genesis_state,
+    partial_state_advance,
+)
+from lighthouse_tpu.state_transition.block import (
+    state_pubkey_bytes_resolver,
+    state_pubkey_resolver,
+)
+from lighthouse_tpu.state_transition.signature_sets import attestation_set
+from lighthouse_tpu.testing import StateHarness
+from lighthouse_tpu.types import MINIMAL, minimal_spec
+
+
+def _harness(fork="phase0", n=32, fake=True):
+    spec = minimal_spec(
+        altair_fork_epoch=0 if fork != "phase0" else None,
+        bellatrix_fork_epoch=0 if fork == "bellatrix" else None,
+    )
+    return StateHarness(MINIMAL, spec, validator_count=n, fork_name=fork, fake_sign=fake)
+
+
+def test_genesis_state_valid():
+    h = _harness()
+    assert len(h.state.validators) == 32
+    st = h.state
+    assert st.genesis_validators_root != bytes(32)
+    spec2 = minimal_spec(min_genesis_time=0)
+    assert is_valid_genesis_state(MINIMAL, spec2, st) is False  # 32 < 64 required
+
+
+@pytest.mark.parametrize("fork", ["phase0", "altair", "bellatrix"])
+def test_extend_chain_one_epoch(fork):
+    h = _harness(fork)
+    blocks = h.extend_chain(MINIMAL.SLOTS_PER_EPOCH + 2, strategy="none")
+    assert h.state.slot == MINIMAL.SLOTS_PER_EPOCH + 2
+    for a, b in zip(blocks, blocks[1:]):
+        assert b.message.parent_root == hash_tree_root(type(a.message), a.message)
+
+
+def test_real_signed_block_individual_verification():
+    h = _harness(fake=False)
+    sb = h.produce_block(1)
+    h.process_block(sb, strategy="individual")
+    assert h.state.slot == 1
+
+
+def test_bad_signature_rejected():
+    h = _harness(fake=False)
+    sb = h.produce_block(1)
+    sb.signature = b"\x11" * 96  # not a valid point encoding
+    with pytest.raises(Exception):
+        h.process_block(sb, strategy="individual")
+
+
+def test_wrong_proposer_rejected():
+    h = _harness()
+    sb = h.produce_block(1)
+    sb.message.proposer_index = (sb.message.proposer_index + 1) % 32
+    with pytest.raises(BlockProcessingError):
+        h.process_block(sb, strategy="none")
+
+
+def test_bulk_signature_verification_and_tamper():
+    h = _harness("altair", fake=False)
+    h.extend_chain(2, strategy="none")  # setup chain (self-signed, unchecked)
+    slot = h.state.slot + 1
+    atts = h.attestations_for_slot(h.state, h.state.slot)
+    sb = h.produce_block(slot, attestations=atts)
+
+    st = copy.deepcopy(h.state)
+    st = partial_state_advance(MINIMAL, h.spec, st, slot)
+    resolver = state_pubkey_resolver(st)
+    acc = BlockSignatureAccumulator(
+        MINIMAL, h.spec, st, resolver, state_pubkey_bytes_resolver(st)
+    )
+    acc.include_all(sb)
+    assert len(acc.sets) >= 2 + len(atts)
+    assert acc.verify() is True
+
+    # tamper: swap an attestation signature for the (valid, but wrong-
+    # message) randao reveal -> the batch must fail
+    bad_att = copy.deepcopy(sb.message.body.attestations[0])
+    bad_att.signature = sb.message.body.randao_reveal
+    acc.sets[-1] = attestation_set(MINIMAL, h.spec, st, bad_att, resolver)
+    assert acc.verify() is False
+
+
+def test_finalization_with_full_participation():
+    h = _harness("phase0")
+    h.extend_chain(4 * MINIMAL.SLOTS_PER_EPOCH, strategy="none")
+    assert h.state.current_justified_checkpoint.epoch > 0
+    assert h.state.finalized_checkpoint.epoch > 0
+
+
+def test_finalization_altair():
+    h = _harness("altair")
+    h.extend_chain(4 * MINIMAL.SLOTS_PER_EPOCH, strategy="none")
+    assert h.state.finalized_checkpoint.epoch > 0
+
+
+def test_epoch_processing_rotates_participation():
+    h = _harness("altair")
+    h.extend_chain(MINIMAL.SLOTS_PER_EPOCH + 1, strategy="none")
+    assert any(h.state.previous_epoch_participation)
+
+
+def test_balances_grow_with_full_participation():
+    h = _harness("altair")
+    before = list(h.state.balances)
+    h.extend_chain(3 * MINIMAL.SLOTS_PER_EPOCH, strategy="none")
+    # with full participation and no leak, total balance must not shrink
+    assert sum(h.state.balances) >= sum(before)

@@ -1,0 +1,114 @@
+"""Consensus-type containers: round-trips, fork variants, domains."""
+
+import pytest
+
+from lighthouse_tpu import ssz
+from lighthouse_tpu.ssz import hash_tree_root
+from lighthouse_tpu.types import (
+    MAINNET,
+    MINIMAL,
+    compute_domain,
+    compute_signing_root,
+    mainnet_spec,
+    minimal_spec,
+    types_for,
+    DOMAIN_BEACON_PROPOSER,
+)
+
+
+@pytest.fixture(params=["mainnet", "minimal"])
+def t(request):
+    return types_for(MAINNET if request.param == "mainnet" else MINIMAL)
+
+
+def test_attestation_roundtrip(t):
+    att = t.Attestation(
+        aggregation_bits=[True, False, True],
+        data=t.AttestationData(
+            slot=5,
+            index=1,
+            beacon_block_root=b"\x01" * 32,
+            source=t.Checkpoint(epoch=0, root=bytes(32)),
+            target=t.Checkpoint(epoch=1, root=b"\x02" * 32),
+        ),
+        signature=b"\x03" * 96,
+    )
+    enc = t.Attestation.encode(att)
+    assert t.Attestation.decode(enc) == att
+    assert len(hash_tree_root(att)) == 32
+
+
+def test_default_state_roundtrip_all_forks(t):
+    for fork in ("phase0", "altair", "bellatrix"):
+        st = t.state[fork]()
+        enc = t.state[fork].encode(st)
+        assert t.state[fork].decode(enc) == st
+        root = hash_tree_root(st)
+        assert len(root) == 32
+        # fork variants must not share roots (field sets differ)
+    roots = {fork: hash_tree_root(t.state[fork]()) for fork in t.state}
+    assert len(set(roots.values())) == 3
+
+
+def test_default_block_roundtrip_all_forks(t):
+    for fork in ("phase0", "altair", "bellatrix"):
+        b = t.signed_block[fork]()
+        enc = t.signed_block[fork].encode(b)
+        assert t.signed_block[fork].decode(enc) == b
+
+
+def test_state_with_validators_roundtrip(t):
+    st = t.state["altair"]()
+    st.validators = [
+        t.Validator(pubkey=bytes([i]) * 48, effective_balance=32 * 10**9)
+        for i in range(5)
+    ]
+    st.balances = [32 * 10**9] * 5
+    st.previous_epoch_participation = [0] * 5
+    st.current_epoch_participation = [7] * 5
+    st.inactivity_scores = [0] * 5
+    enc = t.state["altair"].encode(st)
+    got = t.state["altair"].decode(enc)
+    assert got == st
+    assert got.validators[3].pubkey == bytes([3]) * 48
+
+
+def test_execution_payload_roundtrip(t):
+    p = t.ExecutionPayload(
+        transactions=[b"\x01\x02", b"", b"\xFF" * 100],
+        base_fee_per_gas=10**18,
+        extra_data=b"hi",
+    )
+    enc = t.ExecutionPayload.encode(p)
+    assert t.ExecutionPayload.decode(enc) == p
+
+
+def test_fork_name_schedule():
+    spec = mainnet_spec()
+    assert spec.fork_name_at_epoch(0) == "phase0"
+    assert spec.fork_name_at_epoch(74240) == "altair"
+    assert spec.fork_name_at_epoch(200000) == "bellatrix"
+    mini = minimal_spec(altair_fork_epoch=2, bellatrix_fork_epoch=4)
+    assert mini.fork_name_at_epoch(0) == "phase0"
+    assert mini.fork_name_at_epoch(3) == "altair"
+    assert mini.fork_name_at_epoch(4) == "bellatrix"
+
+
+def test_domains_and_signing_root():
+    spec = mainnet_spec()
+    d = compute_domain(spec, DOMAIN_BEACON_PROPOSER, spec.genesis_fork_version, bytes(32))
+    assert len(d) == 32 and d[:4] == bytes([0, 0, 0, 0])
+    t = types_for(MAINNET)
+    cp = t.Checkpoint(epoch=1, root=b"\x09" * 32)
+    root = compute_signing_root(t.Checkpoint, cp, d)
+    assert len(root) == 32
+    # domain changes the signing root
+    d2 = compute_domain(spec, 1, spec.genesis_fork_version, bytes(32))
+    assert compute_signing_root(t.Checkpoint, cp, d2) != root
+
+
+def test_presets_differ_in_shapes():
+    tm, tn = types_for(MAINNET), types_for(MINIMAL)
+    assert tm.SyncCommittee.fields[0][1].length == 512
+    assert tn.SyncCommittee.fields[0][1].length == 32
+    assert tm.HistoricalBatch.is_fixed() and tn.HistoricalBatch.is_fixed()
