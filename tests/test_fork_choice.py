@@ -1,0 +1,156 @@
+"""Declarative fork-choice scenarios (the reference certifies proto-array
+with vote/FFG scenario scripts, ``consensus/proto_array/src/
+fork_choice_test_definition/``; same style here, no chain required)."""
+
+import pytest
+
+from lighthouse_tpu.fork_choice import (
+    ExecutionStatus,
+    ProtoArrayForkChoice,
+)
+from lighthouse_tpu.fork_choice.proto_array import ProtoArrayError
+
+
+def r(i: int) -> bytes:
+    return bytes([i]) + bytes(31)
+
+
+GENESIS_CP = (0, r(0))
+
+
+def _fresh():
+    return ProtoArrayForkChoice(0, r(0), GENESIS_CP, GENESIS_CP)
+
+
+def _head(p, balances, boost=bytes(32), amount=0):
+    return p.find_head(GENESIS_CP, GENESIS_CP, balances, boost, amount)
+
+
+def test_single_chain_head_is_tip():
+    p = _fresh()
+    p.on_block(1, r(1), r(0), GENESIS_CP, GENESIS_CP)
+    p.on_block(2, r(2), r(1), GENESIS_CP, GENESIS_CP)
+    assert _head(p, [1, 1]) == r(2)
+
+
+def test_votes_decide_between_forks():
+    p = _fresh()
+    p.on_block(1, r(1), r(0), GENESIS_CP, GENESIS_CP)  # fork A
+    p.on_block(1, r(2), r(0), GENESIS_CP, GENESIS_CP)  # fork B
+    # higher-root tie-break first (no votes): r(2) > r(1)
+    assert _head(p, [1, 1]) == r(2)
+    # two votes for A, one for B -> A wins
+    p.process_attestation(0, r(1), 1)
+    p.process_attestation(1, r(1), 1)
+    p.process_attestation(2, r(2), 1)
+    assert _head(p, [1, 1, 1]) == r(1)
+
+
+def test_vote_moves_between_epochs():
+    p = _fresh()
+    p.on_block(1, r(1), r(0), GENESIS_CP, GENESIS_CP)
+    p.on_block(1, r(2), r(0), GENESIS_CP, GENESIS_CP)
+    p.process_attestation(0, r(1), 1)
+    assert _head(p, [1]) == r(1)
+    # same validator re-votes at a later epoch for the other fork
+    p.process_attestation(0, r(2), 2)
+    assert _head(p, [1]) == r(2)
+    # stale re-vote (older epoch) is ignored
+    p.process_attestation(0, r(1), 1)
+    assert _head(p, [1]) == r(2)
+
+
+def test_weight_propagates_to_ancestors():
+    p = _fresh()
+    p.on_block(1, r(1), r(0), GENESIS_CP, GENESIS_CP)
+    p.on_block(2, r(2), r(1), GENESIS_CP, GENESIS_CP)
+    p.on_block(1, r(3), r(0), GENESIS_CP, GENESIS_CP)
+    # deep vote on r(2) beats shallow vote on r(3)
+    p.process_attestation(0, r(2), 1)
+    p.process_attestation(1, r(3), 1)
+    p.process_attestation(2, r(2), 1)
+    assert _head(p, [1, 1, 1]) == r(2)
+
+
+def test_balance_changes_reweight():
+    p = _fresh()
+    p.on_block(1, r(1), r(0), GENESIS_CP, GENESIS_CP)
+    p.on_block(1, r(2), r(0), GENESIS_CP, GENESIS_CP)
+    p.process_attestation(0, r(1), 1)
+    p.process_attestation(1, r(2), 1)
+    assert _head(p, [10, 1]) == r(1)
+    # validator 0's balance collapses -> head flips
+    assert _head(p, [0, 1]) == r(2)
+
+
+def test_ffg_filtering_excludes_wrong_justification():
+    p = _fresh()
+    cp1 = (1, r(1))
+    p.on_block(1, r(1), r(0), GENESIS_CP, GENESIS_CP)
+    p.on_block(2, r(2), r(1), cp1, GENESIS_CP)  # justified by cp1
+    p.on_block(2, r(3), r(1), GENESIS_CP, GENESIS_CP)  # stale justification
+    p.process_attestation(0, r(3), 1)  # heavy vote on the stale branch
+    # with store justified at cp1, only r(2) is viable
+    head = p.find_head(cp1, GENESIS_CP, [10])
+    assert head == r(2)
+
+
+def test_proposer_boost_flips_close_race():
+    p = _fresh()
+    p.on_block(1, r(1), r(0), GENESIS_CP, GENESIS_CP)
+    p.on_block(1, r(2), r(0), GENESIS_CP, GENESIS_CP)
+    p.process_attestation(0, r(1), 1)
+    p.process_attestation(1, r(2), 1)
+    assert _head(p, [2, 1]) == r(1)
+    # boost on r(2) outweighs the 1-unit deficit
+    assert _head(p, [2, 1], boost=r(2), amount=5) == r(2)
+    # boost removed next call -> back to r(1)
+    assert _head(p, [2, 1]) == r(1)
+
+
+def test_equivocation_removes_weight_forever():
+    p = _fresh()
+    p.on_block(1, r(1), r(0), GENESIS_CP, GENESIS_CP)
+    p.on_block(1, r(2), r(0), GENESIS_CP, GENESIS_CP)
+    p.process_attestation(0, r(1), 1)
+    p.process_attestation(1, r(2), 1)
+    assert _head(p, [5, 1]) == r(1)
+    p.process_equivocation(0)
+    assert _head(p, [5, 1]) == r(2)
+    # new votes from the equivocator are ignored
+    p.process_attestation(0, r(1), 9)
+    assert _head(p, [5, 1]) == r(2)
+
+
+def test_execution_invalidation_reroutes_head():
+    p = _fresh()
+    p.on_block(1, r(1), r(0), GENESIS_CP, GENESIS_CP, ExecutionStatus.OPTIMISTIC)
+    p.on_block(2, r(2), r(1), GENESIS_CP, GENESIS_CP, ExecutionStatus.OPTIMISTIC)
+    p.on_block(1, r(3), r(0), GENESIS_CP, GENESIS_CP, ExecutionStatus.OPTIMISTIC)
+    p.process_attestation(0, r(2), 1)
+    assert _head(p, [5]) == r(2)
+    p.on_execution_status(r(1), ExecutionStatus.INVALID)  # kills r(1), r(2)
+    assert _head(p, [5]) == r(3)
+
+
+def test_prune_keeps_descendants_and_head_works():
+    p = _fresh()
+    p.on_block(1, r(1), r(0), GENESIS_CP, GENESIS_CP)
+    p.on_block(2, r(2), r(1), GENESIS_CP, GENESIS_CP)
+    p.on_block(2, r(9), r(1), GENESIS_CP, GENESIS_CP)
+    p.on_block(3, r(3), r(2), GENESIS_CP, GENESIS_CP)
+    p.process_attestation(0, r(3), 1)
+    assert _head(p, [1]) == r(3)
+    p.prune(r(1))
+    assert not p.contains(r(0))
+    assert p.contains(r(2)) and p.contains(r(3)) and p.contains(r(9))
+    # after pruning, heads are computed from the new (retained) anchor
+    assert p.find_head((0, r(1)), (0, r(1)), [1]) == r(3)
+    assert p.is_descendant(r(1), r(3))
+    assert not p.is_descendant(r(9), r(3))
+
+
+def test_unknown_parent_rejected():
+    p = _fresh()
+    with pytest.raises(ProtoArrayError):
+        p.on_block(1, r(1), r(99), GENESIS_CP, GENESIS_CP)
