@@ -1,0 +1,52 @@
+"""Native (C) runtime components, built on demand with the system compiler.
+
+The reference links vendored native libraries for its hot loops (blst asm,
+ring's SHA-NI — ``crypto/eth2_hashing/Cargo.toml``). Here the native layer
+is compiled from checked-in C at first import and loaded via ctypes; every
+caller has a pure-Python fallback so a missing toolchain degrades to slow,
+not broken.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sysconfig
+from pathlib import Path
+
+_DIR = Path(__file__).resolve().parent
+
+
+def _compiler() -> str | None:
+    for cc in (os.environ.get("CC"), "cc", "gcc", "g++", "clang"):
+        if not cc:
+            continue
+        try:
+            subprocess.run([cc, "--version"], capture_output=True, check=True)
+            return cc
+        except (OSError, subprocess.CalledProcessError):
+            continue
+    return None
+
+
+def build_and_load(stem: str, extra_flags: tuple[str, ...] = ()) -> ctypes.CDLL | None:
+    """Compile ``<stem>.c`` into ``lib<stem>.so`` (if stale) and dlopen it.
+    Returns None when no compiler is available or the build fails."""
+    src = _DIR / f"{stem}.c"
+    so = _DIR / f"lib{stem}{sysconfig.get_config_var('EXT_SUFFIX') or '.so'}"
+    if not src.exists():
+        return None
+    if not so.exists() or so.stat().st_mtime < src.stat().st_mtime:
+        cc = _compiler()
+        if cc is None:
+            return None
+        cmd = [cc, "-O3", "-fPIC", "-shared", *extra_flags, str(src), "-o", str(so)]
+        try:
+            subprocess.run(cmd, capture_output=True, check=True)
+        except (OSError, subprocess.CalledProcessError):
+            return None
+    try:
+        return ctypes.CDLL(str(so))
+    except OSError:
+        return None

@@ -198,6 +198,15 @@ class ForkChoice:
             raise ForkChoiceError("unknown attestation head block")
         if self.proto.get_block_slot(data.beacon_block_root) > data.slot:
             raise ForkChoiceError("attestation to a future block")
+        # The LMD vote must be consistent with the FFG target: the head block
+        # must descend from (or be) the claimed target at the target's start
+        # slot, else the attestation moves LMD weight for an impossible vote.
+        target_start = target.epoch * P.SLOTS_PER_EPOCH
+        if (
+            self.proto.ancestor_at_slot(data.beacon_block_root, target_start)
+            != target.root
+        ):
+            raise ForkChoiceError("LMD vote inconsistent with FFG target")
         # LMD votes take effect one slot after creation
         self.queued_attestations.append(
             QueuedAttestation(

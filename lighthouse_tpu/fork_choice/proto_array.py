@@ -116,7 +116,10 @@ class ProtoArrayForkChoice:
         if validator_index in self.equivocating_indices:
             return
         vote = self.votes.setdefault(validator_index, VoteTracker())
-        if target_epoch > vote.next_epoch:
+        # A default tracker (no vote yet) must accept a genesis-epoch vote:
+        # `target_epoch > next_epoch` alone rejects epoch 0 forever.
+        is_default = vote.next_root == self._NO_VOTE and vote.next_epoch == 0
+        if is_default or target_epoch > vote.next_epoch:
             vote.next_root = block_root
             vote.next_epoch = target_epoch
 
@@ -175,7 +178,11 @@ class ProtoArrayForkChoice:
                     deltas[self.index[vote.current_root]] -= old_bal
                 if vote.next_root != self._NO_VOTE and vote.next_root in self.index:
                     deltas[self.index[vote.next_root]] += new_bal
-                    vote.current_root = vote.next_root
+                # Advance unconditionally (reference compute_deltas): if the
+                # advance were gated on `next_root in self.index`, a vote whose
+                # target was pruned would re-subtract old_bal from the surviving
+                # old node on every find_head, driving its weight negative.
+                vote.current_root = vote.next_root
         return deltas
 
     def _apply_score_changes(

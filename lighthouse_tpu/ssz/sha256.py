@@ -2,9 +2,13 @@
 
 The analogue of the reference's ``crypto/eth2_hashing`` (runtime dispatch
 between ring and SHA-NI — ``src/lib.rs:87-177``): one seam,
-``hash_pairs``, through which ALL merkleization flows, so the backend can
-be swapped (hashlib loop now; C++ batched SHA-NI or a device kernel later)
-without touching tree-hash logic.
+``hash_pairs``, through which ALL merkleization flows. Backends:
+
+* native C (``_native/sha256.c``): SHA-NI when the CPU has it, portable
+  scalar otherwise; batch-first export so Python pays one FFI transition
+  per merkle tree level instead of one interpreter round-trip per node;
+* hashlib (OpenSSL) fallback when no C compiler is available — slower per
+  row purely from per-call interpreter overhead, same results.
 """
 
 from __future__ import annotations
@@ -13,26 +17,59 @@ import hashlib
 
 import numpy as np
 
+from .._native import build_and_load as _build_and_load
+
+_lib = _build_and_load("sha256")
+if _lib is not None:
+    import ctypes as _ct
+
+    try:
+        _lib.sha256_hash_pairs.argtypes = [
+            _ct.c_char_p, _ct.c_char_p, _ct.c_size_t
+        ]
+        _lib.sha256_oneshot.argtypes = [_ct.c_char_p, _ct.c_size_t, _ct.c_char_p]
+    except AttributeError:  # symbols missing (unexpected toolchain) -> fallback
+        _lib = None
+
 
 def hash_bytes(data: bytes) -> bytes:
+    if _lib is not None:
+        out = _ct.create_string_buffer(32)
+        _lib.sha256_oneshot(data, len(data), out)
+        return out.raw
     return hashlib.sha256(data).digest()
 
 
 def hash32_concat(a: bytes, b: bytes) -> bytes:
-    return hashlib.sha256(a + b).digest()
+    return hash_bytes(a + b)
+
+
+def _hash_pairs_hashlib(pairs: np.ndarray) -> np.ndarray:
+    out = np.empty((pairs.shape[0], 32), np.uint8)
+    mv = memoryview(np.ascontiguousarray(pairs)).cast("B")
+    for i in range(pairs.shape[0]):
+        out[i] = np.frombuffer(
+            hashlib.sha256(mv[i * 64:(i + 1) * 64]).digest(), np.uint8
+        )
+    return out
 
 
 def hash_pairs(pairs: np.ndarray) -> np.ndarray:
     """uint8[n, 64] -> uint8[n, 32]: SHA-256 of each 64-byte row.
 
-    The merkleization hot loop. Current backend: hashlib (OpenSSL SHA-NI)
-    per row — already native speed per hash; the batch interface is what
-    lets a vectorized backend slot in.
+    The merkleization hot loop: one native batch call when available.
     """
-    out = np.empty((pairs.shape[0], 32), np.uint8)
-    for i in range(pairs.shape[0]):
-        out[i] = np.frombuffer(hashlib.sha256(pairs[i].tobytes()).digest(), np.uint8)
-    return out
+    if _lib is not None:
+        n = pairs.shape[0]
+        pairs = np.ascontiguousarray(pairs)
+        out = np.empty((n, 32), np.uint8)
+        _lib.sha256_hash_pairs(
+            pairs.ctypes.data_as(_ct.c_char_p),
+            out.ctypes.data_as(_ct.c_char_p),
+            n,
+        )
+        return out
+    return _hash_pairs_hashlib(pairs)
 
 
 # Zero-subtree hashes: ZERO_HASHES[d] = root of an all-zero depth-d tree.

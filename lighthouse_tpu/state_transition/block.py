@@ -294,16 +294,12 @@ def process_attestation(
         data.slot + preset.MIN_ATTESTATION_INCLUSION_DELAY <= state.slot,
         "attestation: too early",
     )
-    if fork == "phase0":
-        _require(
-            state.slot <= data.slot + preset.SLOTS_PER_EPOCH,
-            "attestation: too late",
-        )
-    else:
-        _require(
-            state.slot <= data.slot + preset.SLOTS_PER_EPOCH,
-            "attestation: too late",
-        )
+    # One-epoch inclusion window, shared by every pre-Deneb fork (Deneb
+    # removes the upper bound; none of our forks reach it).
+    _require(
+        state.slot <= data.slot + preset.SLOTS_PER_EPOCH,
+        "attestation: too late",
+    )
     _require(
         data.index < get_committee_count_per_slot(preset, state, data.target.epoch),
         "attestation: bad committee index",

@@ -5,6 +5,7 @@
 from __future__ import annotations
 
 from ..ssz import hash_tree_root
+from ..ssz.cache import cached_state_root
 from ..types.chain_spec import ChainSpec
 from ..types.preset import Preset
 from .epoch import process_epoch
@@ -13,7 +14,7 @@ from .upgrade import maybe_upgrade_state
 
 def process_slot(preset: Preset, state) -> None:
     """Cache the previous state/block roots (spec process_slot)."""
-    prev_state_root = hash_tree_root(state)
+    prev_state_root = cached_state_root(state)
     state.state_roots[state.slot % preset.SLOTS_PER_HISTORICAL_ROOT] = prev_state_root
     if state.latest_block_header.state_root == bytes(32):
         state.latest_block_header.state_root = prev_state_root

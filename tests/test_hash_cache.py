@@ -1,0 +1,95 @@
+"""Incremental tree-hash cache: parity with the plain path + native SHA.
+
+Reference analogue: ``consensus/cached_tree_hash`` tests, which assert the
+cached ``BeaconState`` root equals the from-scratch root after arbitrary
+mutations.
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.ssz import hash_tree_root
+from lighthouse_tpu.ssz.cache import CachedRootComputer, MerkleTreeCache
+from lighthouse_tpu.ssz.sha256 import ZERO_HASHES, hash_pairs
+from lighthouse_tpu.state_transition.genesis import interop_genesis_state
+from lighthouse_tpu.types.chain_spec import minimal_spec
+from lighthouse_tpu.types.preset import MINIMAL
+
+
+def _plain_root(leaves: np.ndarray, depth: int) -> bytes:
+    layer = [leaves[i].tobytes() for i in range(leaves.shape[0])]
+    if not layer:
+        return ZERO_HASHES[depth]
+    for d in range(depth):
+        if len(layer) % 2:
+            layer.append(ZERO_HASHES[d])
+        layer = [
+            hashlib.sha256(layer[i] + layer[i + 1]).digest()
+            for i in range(0, len(layer), 2)
+        ]
+    return layer[0]
+
+
+def test_hash_pairs_matches_hashlib(rng):
+    from lighthouse_tpu.ssz.sha256 import _hash_pairs_hashlib
+
+    data = bytes(rng.randrange(256) for _ in range(64 * 300))
+    pairs = np.frombuffer(data, np.uint8).reshape(-1, 64)
+    got = hash_pairs(pairs)
+    fallback = _hash_pairs_hashlib(pairs)
+    for i in range(pairs.shape[0]):
+        want = hashlib.sha256(pairs[i].tobytes()).digest()
+        assert got[i].tobytes() == want
+        assert fallback[i].tobytes() == want
+
+
+def test_hash_bytes_padding_boundaries(rng):
+    from lighthouse_tpu.ssz.sha256 import hash_bytes
+
+    # lengths straddling the 55/56 and 64-byte padding boundaries
+    for n in (0, 1, 55, 56, 57, 63, 64, 65, 127, 128, 1000):
+        data = bytes(rng.randrange(256) for _ in range(n))
+        assert hash_bytes(data) == hashlib.sha256(data).digest()
+
+
+@pytest.mark.parametrize("n", [0, 1, 2, 3, 7, 64, 100])
+def test_tree_cache_matches_plain(rng, n):
+    depth = 10
+    cache = MerkleTreeCache(depth)
+    leaves = np.frombuffer(
+        bytes(rng.randrange(256) for _ in range(32 * n)), np.uint8
+    ).reshape(n, 32).copy()
+    assert cache.update(leaves) == _plain_root(leaves, depth)
+    # small mutation -> incremental path
+    if n:
+        leaves[rng.randrange(n)] ^= 0xFF
+        assert cache.update(leaves) == _plain_root(leaves, depth)
+        # mutate many -> rebuild path
+        for _ in range(max(1, n // 2)):
+            leaves[rng.randrange(n)] ^= 0x55
+        assert cache.update(leaves) == _plain_root(leaves, depth)
+    # growth -> rebuild
+    leaves = np.concatenate([leaves, leaves[:1] if n else np.zeros((1, 32), np.uint8)])
+    assert cache.update(leaves) == _plain_root(leaves, depth)
+
+
+def test_cached_state_root_parity_across_mutation():
+    state = interop_genesis_state(
+        MINIMAL, minimal_spec(), validator_count=16, fork_name="altair"
+    )
+    comp = CachedRootComputer()
+    assert comp.hash_tree_root(state) == hash_tree_root(state)
+    # mutate: balances, one validator, a randao mix, slot
+    state.balances[3] += 1_000_000
+    state.validators[2].effective_balance -= 1
+    state.randao_mixes[1] = bytes([7]) * 32
+    state.slot += 1
+    assert comp.hash_tree_root(state) == hash_tree_root(state)
+    # append a validator (list growth)
+    import copy
+
+    state.validators.append(copy.deepcopy(state.validators[0]))
+    state.balances.append(32 * 10**9)
+    assert comp.hash_tree_root(state) == hash_tree_root(state)
