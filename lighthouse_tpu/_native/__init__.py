@@ -41,10 +41,15 @@ def build_and_load(stem: str, extra_flags: tuple[str, ...] = ()) -> ctypes.CDLL 
         cc = _compiler()
         if cc is None:
             return None
-        cmd = [cc, "-O3", "-fPIC", "-shared", *extra_flags, str(src), "-o", str(so)]
+        # Compile to a per-pid temp path and os.replace: concurrent
+        # importers never dlopen a half-written file.
+        tmp = so.with_suffix(f".tmp{os.getpid()}")
+        cmd = [cc, "-O3", "-fPIC", "-shared", *extra_flags, str(src), "-o", str(tmp)]
         try:
             subprocess.run(cmd, capture_output=True, check=True)
+            os.replace(tmp, so)
         except (OSError, subprocess.CalledProcessError):
+            tmp.unlink(missing_ok=True)
             return None
     try:
         return ctypes.CDLL(str(so))

@@ -17,6 +17,7 @@ from .genesis import (
     is_valid_genesis_state,
 )
 from .merkle import compute_merkle_root, is_valid_merkle_branch
+from .replay import replay_blocks, store_replayer
 from .mutators import initiate_validator_exit, slash_validator
 from .shuffle import compute_shuffled_index, shuffle_list, unshuffle_list
 from .signature_sets import BlockSignatureAccumulator

@@ -1,0 +1,165 @@
+"""Store layer: round-trips, summary replay, migration, iterators.
+
+Reference analogues: ``beacon_node/store/src/hot_cold_store.rs`` tests and
+``memory_store.rs``.
+"""
+
+import copy
+
+import pytest
+
+from lighthouse_tpu.ssz import hash_tree_root
+from lighthouse_tpu.state_transition import store_replayer
+from lighthouse_tpu.store import (
+    Column,
+    HotColdDB,
+    MemoryStore,
+    SqliteStore,
+    block_roots_iter,
+    state_roots_iter,
+)
+from lighthouse_tpu.testing.harness import StateHarness
+from lighthouse_tpu.types.chain_spec import minimal_spec
+from lighthouse_tpu.types.preset import MINIMAL
+
+
+@pytest.fixture(params=["memory", "sqlite"])
+def kv(request, tmp_path):
+    if request.param == "memory":
+        return MemoryStore()
+    return SqliteStore(str(tmp_path / "db.sqlite"))
+
+
+def test_kv_roundtrip_and_batch(kv):
+    kv.put(Column.BLOCK, b"a", b"1")
+    kv.put_batch([(Column.BLOCK, b"b", b"2"), (Column.STATE, b"a", b"3")])
+    assert kv.get(Column.BLOCK, b"a") == b"1"
+    assert kv.get(Column.BLOCK, b"b") == b"2"
+    assert kv.get(Column.STATE, b"a") == b"3"
+    assert kv.get(Column.STATE, b"zz") is None
+    assert list(kv.keys(Column.BLOCK)) == [b"a", b"b"]
+    kv.delete(Column.BLOCK, b"a")
+    assert kv.get(Column.BLOCK, b"a") is None
+    assert list(kv.iter_column(Column.STATE)) == [(b"a", b"3")]
+
+
+@pytest.fixture(scope="module")
+def chain():
+    """A 12-block phase0 chain with per-block post-states."""
+    h = StateHarness(MINIMAL, minimal_spec(), validator_count=8, fork_name="phase0", fake_sign=True)
+    genesis = copy.deepcopy(h.state)
+    records = []  # (block_root, signed_block, state_root, state)
+    for _ in range(12):
+        sb = h.extend_chain(1, strategy="none", attest=False)[0]
+        state = copy.deepcopy(h.state)
+        records.append(
+            (hash_tree_root(sb.message), sb, hash_tree_root(state), state)
+        )
+    return h, genesis, records
+
+
+def _make_db(kv, h, snapshot_every=4):
+    db = HotColdDB(
+        kv,
+        h.t,
+        h.spec,
+        store_replayer(h.preset, h.spec),
+        slots_per_snapshot=snapshot_every,
+        slots_per_restore_point=8,
+    )
+    return db
+
+
+def test_block_roundtrip(kv, chain):
+    h, genesis, records = chain
+    db = _make_db(kv, h)
+    root, sb, *_ = records[0]
+    db.put_block(root, sb)
+    got = db.get_block(root)
+    assert type(got).encode(got) == type(sb).encode(sb)
+    assert db.block_exists(root)
+    assert not db.block_exists(bytes(32))
+
+
+def test_state_snapshot_and_summary_replay(kv, chain):
+    h, genesis, records = chain
+    db = _make_db(kv, h, snapshot_every=4)
+    # anchor: the genesis state is always a full snapshot
+    db.put_state_snapshot(hash_tree_root(genesis), genesis)
+    for root, sb, sroot, state in records:
+        db.put_block(root, sb)
+        db.put_state(sroot, state)
+    for _, _, sroot, state in records:
+        loaded = db.get_state(sroot)
+        assert loaded is not None, f"state at slot {state.slot} not loadable"
+        assert hash_tree_root(loaded) == sroot, f"replay mismatch at slot {state.slot}"
+
+
+def test_migration_freezes_history(kv, chain):
+    h, genesis, records = chain
+    db = _make_db(kv, h, snapshot_every=4)
+    db.put_state_snapshot(hash_tree_root(genesis), genesis)
+    for root, sb, sroot, state in records:
+        db.put_block(root, sb)
+        db.put_state(sroot, state)
+    # migrate at the 8th block's state
+    root8, _, sroot8, state8 = records[7]
+    db.migrate(sroot8, state8)
+    assert db.split_slot == state8.slot
+    # frozen per-slot indexes exist
+    assert db.cold_block_root_at_slot(records[3][3].slot) == records[3][0]
+    listed = list(db.forwards_block_roots(1, state8.slot))
+    assert (records[0][3].slot, records[0][0]) in listed
+    # states above the split still load
+    for _, _, sroot, state in records[7:]:
+        assert hash_tree_root(db.get_state(sroot)) == sroot
+    # the finalized state itself still loads (anchor snapshot)
+    assert hash_tree_root(db.get_state(sroot8)) == sroot8
+
+
+def test_cold_state_replay_after_migration(kv, chain):
+    """Frozen states that are NOT restore points must still load (via
+    restore-point + cold-index replay), and restore-point slots that were
+    stored as summaries must be materialized during migration."""
+    h, genesis, records = chain
+    # restore point every 4 slots, but snapshots only every 8: slot-4-aligned
+    # states are summaries and must be materialized by migrate()
+    db = HotColdDB(
+        kv, h.t, h.spec, store_replayer(h.preset, h.spec),
+        slots_per_snapshot=8, slots_per_restore_point=4,
+    )
+    db.put_state_snapshot(hash_tree_root(genesis), genesis)
+    for root, sb, sroot, state in records:
+        db.put_block(root, sb)
+        db.put_state(sroot, state)
+    _, _, sroot_fin, state_fin = records[-2]
+    db.migrate(sroot_fin, state_fin)
+    # every frozen state still loads bit-exactly
+    for _, _, sroot, state in records[:-2]:
+        loaded = db.get_state(sroot)
+        assert loaded is not None, f"frozen state at slot {state.slot} unloadable"
+        assert hash_tree_root(loaded) == sroot, f"cold replay mismatch slot {state.slot}"
+
+
+def test_iterators(kv, chain):
+    h, genesis, records = chain
+    db = _make_db(kv, h)
+    for root, sb, sroot, state in records:
+        db.put_block(root, sb)
+        db.put_state(sroot, state)
+    head_root = records[-1][0]
+    walked = list(block_roots_iter(db, head_root))
+    assert walked[0] == (records[-1][3].slot, head_root)
+    assert len(walked) == len(records)  # stops when parent (genesis) missing
+    sroots = list(state_roots_iter(db, records[-1][2]))
+    assert sroots[0][1] == records[-1][2]
+    assert len(sroots) >= len(records)
+
+
+def test_head_and_metadata(kv, chain):
+    h, genesis, records = chain
+    db = _make_db(kv, h)
+    db.put_head(records[-1][0])
+    assert db.get_head() == records[-1][0]
+    db.put_genesis_state_root(b"\x01" * 32)
+    assert db.get_genesis_state_root() == b"\x01" * 32
