@@ -1,68 +1,119 @@
 """North-star benchmark: BLS signature-set verifications/sec on one chip.
 
-Workload shape follows BASELINE.md config #3 (gossip aggregate batch): each
-aggregate attestation costs three signature sets (selection proof,
+Workload shape follows BASELINE.md config #3 (gossip aggregate batch):
+each aggregate attestation costs three signature sets (selection proof,
 aggregator signature, aggregate attestation signature over the committee —
-reference: ``beacon_node/beacon_chain/src/attestation_verification/batch.rs:77-107``).
-Here: B sets per device batch with a mix of single-pubkey and
-committee-aggregation (multi-pubkey) sets, pre-hashed messages (message
-de-dup mirrors the 64-committees-per-slot structure).
+reference ``beacon_node/beacon_chain/src/attestation_verification/batch.rs:77-107``).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where
-``vs_baseline`` is measured against the 50k aggregate-verifications/sec
-target from BASELINE.json (an aggregate = 3 sets).
+END-TO-END measurement (VERDICT r1 weakness #3): every rep re-packs the
+raw (signature, pubkeys, message) sets — host point packing + randomness +
+hash_to_field — and runs the device program, which hashes the messages to
+G2 on device (``device/htc.py``) and verifies. Nothing is pre-hashed.
+
+Robustness (round-1 BENCH died at TPU init): the TPU backend is probed in
+a SUBPROCESS with a deadline first; if the probe fails or times out the
+bench falls back to the CPU backend so a measurement is always printed.
+Persistent compilation cache keeps the recurring driver runs cheap.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} with
+``vs_baseline`` measured against the 50k aggregate-verifications/sec
+target from BASELINE.json (one aggregate = 3 sets).
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
-import jax
-
-from lighthouse_tpu.crypto import bls
-from lighthouse_tpu.crypto.device.bls import pack_signature_sets, verify_batch
-
-# Batch geometry: 64 aggregates -> 192 sets (2/3 single-pubkey, 1/3
-# committee sets with COMMITTEE pubkeys), padded to the (256, 16) bucket.
 N_AGG = 64
 COMMITTEE = 16
+N_MSGS = 8
 B_PAD = 256
 K_PAD = 16
+M_PAD = 8
 TARGET_AGG_PER_SEC = 50_000.0
+PROBE_TIMEOUT_S = 240
 
 
-def build_batch():
-    sets = []
-    n_msgs = 8  # distinct AttestationData roots in flight
+def probe_tpu() -> bool:
+    """Can the TPU backend initialize at all? Run in a subprocess so a
+    hung tunnel cannot wedge the bench itself."""
+    code = "import jax; assert jax.devices()[0].platform != 'cpu'"
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            timeout=PROBE_TIMEOUT_S,
+            capture_output=True,
+        )
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def build_sets():
+    """Raw signature sets, reference mix: per aggregate, two single-pubkey
+    sets + one committee set. Aggregate signatures are produced with the
+    summed secret key (same group element as aggregating per-signer
+    signatures) to keep host-oracle setup time bounded."""
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.crypto.params import R
+
     sks = [bls.SecretKey(1_000 + i) for i in range(COMMITTEE)]
     pks = [sk.public_key().point for sk in sks]
-    msgs = [bytes([m + 1]) * 32 for m in range(n_msgs)]
-    sigs = [[sk.sign(m) for sk in sks] for m in msgs]
+    sk_agg = bls.SecretKey(sum(1_000 + i for i in range(COMMITTEE)) % R)
+    msgs = [bytes([m + 1]) * 32 for m in range(N_MSGS)]
+    single0 = {m: sks[0].sign(m).point for m in msgs}
+    single1 = {m: sks[1].sign(m).point for m in msgs}
+    agg = {m: sk_agg.sign(m).point for m in msgs}
+
+    sets = []
     for i in range(N_AGG):
-        m = i % n_msgs
-        # selection proof + aggregator signature (single-pubkey sets)
-        sets.append((sigs[m][0].point, [pks[0]], msgs[m]))
-        sets.append((sigs[m][1].point, [pks[1]], msgs[m]))
-        # aggregate attestation signature (committee set)
-        agg = bls.AggregateSignature.infinity()
-        for s in sigs[m]:
-            agg.add_assign(s)
-        sets.append((agg.point, pks, msgs[m]))
-    return pack_signature_sets(sets, pad_b=B_PAD, pad_k=K_PAD), len(sets)
+        m = msgs[i % N_MSGS]
+        sets.append((single0[m], [pks[0]], m))
+        sets.append((single1[m], [pks[1]], m))
+        sets.append((agg[m], pks, m))
+    return sets
 
 
 def main() -> None:
-    args, n_sets = build_batch()
-    # Warm-up: compile (first TPU compile is slow; cached afterwards).
-    ok = verify_batch(*args)
+    if not probe_tpu():
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    try:
+        cache_dir = os.path.join(os.path.dirname(__file__) or ".", ".jax_cache")
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
+    from lighthouse_tpu.crypto.device.bls import (
+        pack_signature_sets_hashed,
+        verify_batch_hashed,
+    )
+
+    sets = build_sets()
+    n_sets = len(sets)
+
+    def run_once():
+        args = pack_signature_sets_hashed(
+            sets, pad_b=B_PAD, pad_k=K_PAD, pad_m=M_PAD
+        )
+        out = verify_batch_hashed(*args)
+        jax.block_until_ready(out)
+        return out
+
+    ok = run_once()  # warm-up: compile
     assert bool(ok) is True, "benchmark batch must verify"
 
     reps = 3
     t0 = time.perf_counter()
     for _ in range(reps):
-        out = verify_batch(*args)
-    jax.block_until_ready(out)
+        out = run_once()
     dt = (time.perf_counter() - t0) / reps
 
     sets_per_sec = n_sets / dt

@@ -1,0 +1,47 @@
+"""L4 core runtime: the BeaconChain and its verification pipelines.
+
+Reference: ``beacon_node/beacon_chain`` (SURVEY.md §2.4).
+"""
+
+from .attestation_verification import (
+    AttestationError,
+    VerifiedAggregatedAttestation,
+    VerifiedUnaggregatedAttestation,
+    batch_verify_aggregated_attestations,
+    batch_verify_unaggregated_attestations,
+)
+from .block_verification import (
+    BlockError,
+    ExecutionPendingBlock,
+    GossipVerifiedBlock,
+    SignatureVerifiedBlock,
+)
+from .chain import BeaconChain, ShufflingCache, SnapshotCache
+from .observed import (
+    ObservedAggregates,
+    ObservedAggregators,
+    ObservedAttesters,
+    ObservedBlockProducers,
+    ObservedOperations,
+)
+from .pubkey_cache import ValidatorPubkeyCache
+
+__all__ = [
+    "AttestationError",
+    "BeaconChain",
+    "BlockError",
+    "ExecutionPendingBlock",
+    "GossipVerifiedBlock",
+    "ObservedAggregates",
+    "ObservedAggregators",
+    "ObservedAttesters",
+    "ObservedBlockProducers",
+    "ObservedOperations",
+    "ShufflingCache",
+    "SignatureVerifiedBlock",
+    "SnapshotCache",
+    "ValidatorPubkeyCache",
+    "VerifiedAggregatedAttestation",
+    "VerifiedUnaggregatedAttestation",
+    "ValidatorPubkeyCache",
+]

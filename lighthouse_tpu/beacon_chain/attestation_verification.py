@@ -1,0 +1,256 @@
+"""Gossip attestation verification, single and batched (reference:
+``beacon_node/beacon_chain/src/attestation_verification.rs`` and
+``attestation_verification/batch.rs:31-222``).
+
+The batch paths are the TPU feeder: N structural-verified attestations
+become one backend ``verify_signature_sets`` call (1 set per unaggregated
+attestation; 3 per aggregate — ``batch.rs:77-107,182-196``). On a batch
+failure, items are re-verified individually so per-item results are
+identical to the non-batched path (``batch.rs:1-11,115-119``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+
+from ..crypto import bls
+from ..ssz import hash_tree_root
+from ..state_transition.helpers import compute_epoch_at_slot
+from ..state_transition.signature_sets import (
+    aggregate_and_proof_sets,
+    indexed_attestation_set,
+)
+from ..utils import metrics
+
+ATTESTATION_PROPAGATION_SLOT_RANGE = 32
+TARGET_AGGREGATORS_PER_COMMITTEE = 16
+
+_BATCH_SETUP = metrics.histogram(
+    "attestation_batch_setup_seconds",
+    "structural checks + set building for a gossip attestation batch",
+)
+_BATCH_SIG = metrics.histogram(
+    "attestation_batch_signature_seconds",
+    "backend batch signature verification for a gossip attestation batch",
+)
+
+
+class AttestationError(ValueError):
+    """Structural/gossip-rule rejection; ``kind`` mirrors the reference's
+    error enum so batch fallback can report per-item outcomes."""
+
+    def __init__(self, kind: str, detail: str = ""):
+        super().__init__(f"{kind}{': ' + detail if detail else ''}")
+        self.kind = kind
+
+
+@dataclass
+class VerifiedUnaggregatedAttestation:
+    attestation: object
+    indexed: object
+    validator_index: int
+    committee_index: int
+
+
+@dataclass
+class VerifiedAggregatedAttestation:
+    signed_aggregate: object
+    indexed: object
+    aggregator_index: int
+
+
+def _committee_for(chain, data):
+    epoch = compute_epoch_at_slot(chain.preset, data.slot)
+    cache = chain.shuffling_cache.get(chain, epoch, data.target.root)
+    count = cache.committees_per_slot
+    if data.index >= count:
+        raise AttestationError("BadCommitteeIndex", f"{data.index} >= {count}")
+    return cache.committee(data.slot, data.index)
+
+
+def _structural_unaggregated(chain, att, current_slot: int):
+    """Everything except the signature; returns (indexed, validator_index)."""
+    data = att.data
+    if data.target.epoch != compute_epoch_at_slot(chain.preset, data.slot):
+        raise AttestationError("BadTargetEpoch")
+    if not (
+        data.slot <= current_slot <= data.slot + ATTESTATION_PROPAGATION_SLOT_RANGE
+    ):
+        raise AttestationError(
+            "OutsideSlotRange", f"slot {data.slot} vs current {current_slot}"
+        )
+    bits = list(att.aggregation_bits)
+    if sum(bits) != 1:
+        raise AttestationError("NotExactlyOneBit")
+    if not chain.fork_choice.proto.contains(bytes(data.beacon_block_root)):
+        raise AttestationError("UnknownHeadBlock", data.beacon_block_root.hex()[:12])
+    if not chain.fork_choice.proto.contains(bytes(data.target.root)):
+        raise AttestationError("UnknownTargetRoot")
+    committee = _committee_for(chain, data)
+    if len(bits) != len(committee):
+        raise AttestationError(
+            "BitsCommitteeMismatch", f"{len(bits)} != {len(committee)}"
+        )
+    validator_index = int(committee[bits.index(True)])
+    if chain.observed_attesters.is_known(validator_index, data.target.epoch):
+        raise AttestationError("PriorAttestationKnown", str(validator_index))
+    t = chain.types
+    indexed = t.IndexedAttestation(
+        attesting_indices=[validator_index], data=data, signature=att.signature
+    )
+    return indexed, validator_index
+
+
+def verify_unaggregated_attestation(chain, att, current_slot: int):
+    """Single-item gossip path (reference
+    ``IndexedUnaggregatedAttestation::verify``)."""
+    indexed, validator_index = _structural_unaggregated(chain, att, current_slot)
+    s = indexed_attestation_set(
+        chain.preset, chain.spec, chain.head_state, indexed,
+        chain.pubkey_cache.resolver(),
+    )
+    if not bls.verify_signature_sets([s]):
+        raise AttestationError("InvalidSignature")
+    chain.observed_attesters.observe(validator_index, att.data.target.epoch)
+    return VerifiedUnaggregatedAttestation(att, indexed, validator_index, att.data.index)
+
+
+def batch_verify_unaggregated_attestations(chain, attestations, current_slot: int):
+    """One backend call for the whole batch; identical per-item results to
+    the single path (reference ``batch.rs:139-222``). Returns a list of
+    ``VerifiedUnaggregatedAttestation | AttestationError`` per input."""
+    results: list[object] = [None] * len(attestations)
+    pending = []  # (pos, att, indexed, validator_index, set)
+    with _BATCH_SETUP.time():
+        for pos, att in enumerate(attestations):
+            try:
+                indexed, vindex = _structural_unaggregated(chain, att, current_slot)
+                s = indexed_attestation_set(
+                    chain.preset, chain.spec, chain.head_state, indexed,
+                    chain.pubkey_cache.resolver(),
+                )
+                pending.append((pos, att, indexed, vindex, s))
+            except AttestationError as e:
+                results[pos] = e
+    with _BATCH_SIG.time():
+        batch_ok = bool(pending) and bls.verify_signature_sets(
+            [p[4] for p in pending]
+        )
+    for pos, att, indexed, vindex, s in pending:
+        if batch_ok or bls.verify_signature_sets([s]):
+            # observe() returning True = duplicate WITHIN this batch (the
+            # pre-batch is_known check ran before any item was observed);
+            # reject it exactly as the sequential path would.
+            if chain.observed_attesters.observe(vindex, att.data.target.epoch):
+                results[pos] = AttestationError("PriorAttestationKnown")
+            else:
+                results[pos] = VerifiedUnaggregatedAttestation(
+                    att, indexed, vindex, att.data.index
+                )
+        else:
+            results[pos] = AttestationError("InvalidSignature")
+    return results
+
+
+def _is_aggregator(committee_len: int, selection_proof: bytes) -> bool:
+    """Spec ``is_aggregator``: SHA-256 of the proof mod the per-committee
+    aggregator modulus."""
+    modulo = max(1, committee_len // TARGET_AGGREGATORS_PER_COMMITTEE)
+    h = hashlib.sha256(selection_proof).digest()
+    return int.from_bytes(h[:8], "little") % modulo == 0
+
+
+def _structural_aggregated(chain, signed_agg, current_slot: int):
+    msg = signed_agg.message
+    att = msg.aggregate
+    data = att.data
+    if data.target.epoch != compute_epoch_at_slot(chain.preset, data.slot):
+        raise AttestationError("BadTargetEpoch")
+    if not (
+        data.slot <= current_slot <= data.slot + ATTESTATION_PROPAGATION_SLOT_RANGE
+    ):
+        raise AttestationError("OutsideSlotRange")
+    att_root = hash_tree_root(att)
+    if chain.observed_aggregates.is_known(att_root, data.slot):
+        raise AttestationError("AttestationAlreadyKnown")
+    if chain.observed_aggregators.is_known(msg.aggregator_index, data.target.epoch):
+        raise AttestationError("AggregatorAlreadyKnown")
+    if not chain.fork_choice.proto.contains(bytes(data.beacon_block_root)):
+        raise AttestationError("UnknownHeadBlock")
+    if not chain.fork_choice.proto.contains(bytes(data.target.root)):
+        raise AttestationError("UnknownTargetRoot")
+    committee = _committee_for(chain, data)
+    bits = list(att.aggregation_bits)
+    if len(bits) != len(committee):
+        raise AttestationError("BitsCommitteeMismatch")
+    if not any(bits):
+        raise AttestationError("EmptyAggregationBits")
+    if msg.aggregator_index not in [int(i) for i in committee]:
+        raise AttestationError("AggregatorNotInCommittee")
+    if not _is_aggregator(len(committee), bytes(msg.selection_proof)):
+        raise AttestationError("InvalidSelectionProof")
+    attesting = [int(v) for v, b in zip(committee, bits) if b]
+    t = chain.types
+    indexed = t.IndexedAttestation(
+        attesting_indices=sorted(attesting), data=data, signature=att.signature
+    )
+    return indexed, att_root
+
+
+def verify_aggregated_attestation(chain, signed_agg, current_slot: int):
+    """Single aggregate: 3 signature sets (reference ``batch.rs:77-107``)."""
+    indexed, att_root = _structural_aggregated(chain, signed_agg, current_slot)
+    sets = aggregate_and_proof_sets(
+        chain.preset, chain.spec, chain.head_state, signed_agg,
+        chain.pubkey_cache.resolver(),
+    )
+    if not bls.verify_signature_sets(sets):
+        raise AttestationError("InvalidSignature")
+    msg = signed_agg.message
+    chain.observed_aggregates.observe(att_root, msg.aggregate.data.slot)
+    chain.observed_aggregators.observe(msg.aggregator_index, msg.aggregate.data.target.epoch)
+    return VerifiedAggregatedAttestation(signed_agg, indexed, msg.aggregator_index)
+
+
+def batch_verify_aggregated_attestations(chain, signed_aggs, current_slot: int):
+    """3N sets in one backend call, per-item fallback on failure
+    (reference ``batch.rs:31-134``)."""
+    results: list[object] = [None] * len(signed_aggs)
+    pending = []
+    with _BATCH_SETUP.time():
+        for pos, sa in enumerate(signed_aggs):
+            try:
+                indexed, att_root = _structural_aggregated(chain, sa, current_slot)
+                sets = aggregate_and_proof_sets(
+                    chain.preset, chain.spec, chain.head_state, sa,
+                    chain.pubkey_cache.resolver(),
+                )
+                pending.append((pos, sa, indexed, att_root, sets))
+            except AttestationError as e:
+                results[pos] = e
+    with _BATCH_SIG.time():
+        all_sets = [s for p in pending for s in p[4]]
+        batch_ok = bool(pending) and bls.verify_signature_sets(all_sets)
+    for pos, sa, indexed, att_root, sets in pending:
+        if batch_ok or bls.verify_signature_sets(sets):
+            msg = sa.message
+            # intra-batch duplicates: observe() returns True when another
+            # item of this batch already recorded the root/aggregator
+            dup_root = chain.observed_aggregates.observe(
+                att_root, msg.aggregate.data.slot
+            )
+            dup_aggregator = chain.observed_aggregators.observe(
+                msg.aggregator_index, msg.aggregate.data.target.epoch
+            )
+            if dup_root:
+                results[pos] = AttestationError("AttestationAlreadyKnown")
+            elif dup_aggregator:
+                results[pos] = AttestationError("AggregatorAlreadyKnown")
+            else:
+                results[pos] = VerifiedAggregatedAttestation(
+                    sa, indexed, msg.aggregator_index
+                )
+        else:
+            results[pos] = AttestationError("InvalidSignature")
+    return results

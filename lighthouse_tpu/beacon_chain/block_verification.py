@@ -1,0 +1,136 @@
+"""Block import pipeline types (reference:
+``beacon_node/beacon_chain/src/block_verification.rs:21-44,590-660``):
+
+    gossip bytes -> GossipVerifiedBlock  (cheap checks + ONE proposal sig)
+                 -> SignatureVerifiedBlock (ALL block sigs, one batch)
+                 -> ExecutionPendingBlock  (payload sent to the EL)
+                 -> imported (fork choice + store)
+
+Each stage owns the evidence of the previous one; ``BeaconChain.process_block``
+drives the chain of custody.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field as dc_field
+
+from ..crypto import bls
+from ..ssz import hash_tree_root
+from ..state_transition import (
+    BlockSignatureAccumulator,
+    partial_state_advance,
+    get_beacon_proposer_index,
+)
+from ..state_transition.epoch import fork_of
+from ..state_transition.signature_sets import block_proposal_set
+
+
+class BlockError(ValueError):
+    def __init__(self, kind: str, detail: str = ""):
+        super().__init__(f"{kind}{': ' + detail if detail else ''}")
+        self.kind = kind
+
+
+@dataclass
+class GossipVerifiedBlock:
+    """Propagation-safe: structure + proposer + proposal signature checked
+    against an advanced parent state (reference ``block_verification.rs:590``)."""
+
+    signed_block: object
+    block_root: bytes
+    state: object  # parent state advanced to block.slot (pre-block)
+
+    @classmethod
+    def new(cls, chain, signed_block):
+        block = signed_block.message
+        block_root = hash_tree_root(block)
+        current_slot = chain.slot()
+
+        if block.slot > current_slot:
+            raise BlockError("FutureSlot", f"{block.slot} > {current_slot}")
+        fin_epoch, _ = chain.fork_choice.store.finalized_checkpoint
+        if block.slot <= fin_epoch * chain.preset.SLOTS_PER_EPOCH:
+            raise BlockError("WouldRevertFinalizedSlot")
+        if chain.fork_choice.proto.contains(block_root):
+            raise BlockError("BlockIsAlreadyKnown")
+        if chain.observed_block_producers.is_known(block.proposer_index, block.slot):
+            raise BlockError("RepeatProposal")
+        parent_root = bytes(block.parent_root)
+        if not chain.fork_choice.proto.contains(parent_root):
+            raise BlockError("ParentUnknown", parent_root.hex()[:12])
+
+        state = chain.state_at_block_root(parent_root)
+        state = partial_state_advance(chain.preset, chain.spec, copy.deepcopy(state), block.slot)
+        expected = get_beacon_proposer_index(chain.preset, state)
+        if expected != block.proposer_index:
+            raise BlockError(
+                "IncorrectBlockProposer", f"{block.proposer_index} != {expected}"
+            )
+        s = block_proposal_set(
+            chain.preset, chain.spec, state, signed_block,
+            chain.pubkey_cache.resolver(), block_root=block_root,
+        )
+        if not bls.verify_signature_sets([s]):
+            raise BlockError("ProposalSignatureInvalid")
+        chain.observed_block_producers.observe(block.proposer_index, block.slot)
+        return cls(signed_block, block_root, state)
+
+
+@dataclass
+class SignatureVerifiedBlock:
+    """Every signature in the block verified as ONE batch — the
+    north-star consumer (reference ``block_verification.rs:599`` +
+    ``block_signature_verifier.rs:120-132``)."""
+
+    signed_block: object
+    block_root: bytes
+    state: object
+    proposal_already_verified: bool = False
+
+    @classmethod
+    def from_gossip(cls, gossip: GossipVerifiedBlock, chain):
+        return cls._verify(
+            chain, gossip.signed_block, gossip.block_root, gossip.state,
+            skip_proposal=True,
+        )
+
+    @classmethod
+    def new(cls, chain, signed_block, block_root=None):
+        block = signed_block.message
+        if block_root is None:
+            block_root = hash_tree_root(block)
+        parent_root = bytes(block.parent_root)
+        if not chain.fork_choice.proto.contains(parent_root):
+            raise BlockError("ParentUnknown", parent_root.hex()[:12])
+        state = chain.state_at_block_root(parent_root)
+        state = partial_state_advance(
+            chain.preset, chain.spec, copy.deepcopy(state), block.slot
+        )
+        return cls._verify(chain, signed_block, block_root, state, skip_proposal=False)
+
+    @classmethod
+    def _verify(cls, chain, signed_block, block_root, state, skip_proposal):
+        acc = BlockSignatureAccumulator(
+            chain.preset, chain.spec, state, chain.pubkey_cache.resolver(),
+            resolver_by_pubkey_bytes=chain.pubkey_resolver_by_bytes(),
+        )
+        if skip_proposal:
+            acc.include_randao_reveal(signed_block.message)
+            acc.include_operations(signed_block)
+        else:
+            acc.include_all(signed_block, block_root=block_root)
+        if not acc.verify():
+            raise BlockError("InvalidSignature")
+        return cls(signed_block, block_root, state, skip_proposal)
+
+
+@dataclass
+class ExecutionPendingBlock:
+    """Consensus-verified; payload handed to the execution layer whose
+    verdict is joined at import (reference ``block_verification.rs:621``)."""
+
+    signed_block: object
+    block_root: bytes
+    state: object
+    payload_verification_handle: object = dc_field(default=None)

@@ -1,0 +1,374 @@
+"""The BeaconChain runtime (reference:
+``beacon_node/beacon_chain/src/beacon_chain.rs`` — the god object wiring
+store, fork choice, caches, and verification pipelines; ``process_block``
+at :2495, ``produce_block_on_state`` :3364, ``process_chain_segment``
+:2340, head recompute ``canonical_head.rs:449``).
+
+This is the consumer that feeds the TPU BLS backend its real workload:
+block imports batch every block signature through
+``SignatureVerifiedBlock``; gossip attestations batch through
+``attestation_verification``.
+"""
+
+from __future__ import annotations
+
+import copy
+from collections import OrderedDict
+
+from ..fork_choice.fork_choice import ForkChoice
+from ..fork_choice.proto_array import ExecutionStatus
+from ..ssz import hash_tree_root
+from ..ssz.cache import CachedRootComputer
+from ..state_transition import (
+    CommitteeCache,
+    get_indexed_attestation,
+    partial_state_advance,
+    process_block as st_process_block,
+    get_beacon_proposer_index,
+)
+from ..state_transition.epoch import fork_of
+from ..state_transition.helpers import compute_epoch_at_slot
+from ..utils import metrics
+from ..utils.slot_clock import SlotClock
+from .attestation_verification import (
+    batch_verify_aggregated_attestations,
+    batch_verify_unaggregated_attestations,
+    verify_aggregated_attestation,
+    verify_unaggregated_attestation,
+)
+from .block_verification import (
+    BlockError,
+    ExecutionPendingBlock,
+    GossipVerifiedBlock,
+    SignatureVerifiedBlock,
+)
+from .observed import (
+    ObservedAggregates,
+    ObservedAggregators,
+    ObservedAttesters,
+    ObservedBlockProducers,
+    ObservedOperations,
+)
+from .pubkey_cache import ValidatorPubkeyCache
+
+_BLOCK_PROCESSING = metrics.histogram(
+    "block_processing_seconds", "full block import wall time"
+)
+_HEAD_RECOMPUTE = metrics.counter("head_recompute_total", "get_head invocations")
+
+
+class SnapshotCache:
+    """Post-states of recent blocks by block root (reference
+    ``snapshot_cache.rs``, DEFAULT_SNAPSHOT_CACHE_SIZE=4)."""
+
+    def __init__(self, cap: int = 4):
+        self.cap = cap
+        self._map: OrderedDict[bytes, object] = OrderedDict()
+
+    def insert(self, block_root: bytes, state) -> None:
+        self._map[block_root] = state
+        self._map.move_to_end(block_root)
+        while len(self._map) > self.cap:
+            self._map.popitem(last=False)
+
+    def get(self, block_root: bytes):
+        state = self._map.get(block_root)
+        if state is not None:
+            self._map.move_to_end(block_root)
+        return state
+
+
+class ShufflingCache:
+    """Committee caches keyed by (epoch, target root) (reference
+    ``shuffling_cache.rs``)."""
+
+    def __init__(self, cap: int = 16):
+        self.cap = cap
+        self._map: OrderedDict[tuple, CommitteeCache] = OrderedDict()
+
+    def get(self, chain, epoch: int, target_root: bytes) -> CommitteeCache:
+        key = (epoch, bytes(target_root))
+        hit = self._map.get(key)
+        if hit is not None:
+            self._map.move_to_end(key)
+            return hit
+        cache = CommitteeCache(chain.preset, chain.head_state, epoch)
+        self._map[key] = cache
+        while len(self._map) > self.cap:
+            self._map.popitem(last=False)
+        return cache
+
+
+class BeaconChain:
+    def __init__(self, preset, spec, types, store, genesis_state, slot_clock=None):
+        self.preset = preset
+        self.spec = spec
+        self.types = types
+        self.store = store
+        self.slot_clock = slot_clock or SlotClock(
+            genesis_state.genesis_time, spec.seconds_per_slot
+        )
+
+        self.genesis_state_root = hash_tree_root(genesis_state)
+        genesis_block_root = _anchor_block_root(genesis_state)
+        self.genesis_block_root = genesis_block_root
+
+        self.fork_choice = ForkChoice(
+            preset,
+            spec,
+            genesis_state.slot,
+            genesis_block_root,
+            (
+                genesis_state.current_justified_checkpoint.epoch,
+                genesis_block_root,
+            ),
+            (genesis_state.finalized_checkpoint.epoch, genesis_block_root),
+            [v.effective_balance for v in genesis_state.validators],
+        )
+
+        self.pubkey_cache = ValidatorPubkeyCache(store)
+        self.pubkey_cache.import_new_pubkeys(genesis_state)
+        self.observed_attesters = ObservedAttesters()
+        self.observed_aggregators = ObservedAggregators()
+        self.observed_aggregates = ObservedAggregates()
+        self.observed_block_producers = ObservedBlockProducers()
+        self.observed_operations = ObservedOperations()
+        self.snapshot_cache = SnapshotCache()
+        self.shuffling_cache = ShufflingCache()
+        self.root_computer = CachedRootComputer()
+        self.op_pool = None  # attached by the client builder when present
+
+        self.head_block_root = genesis_block_root
+        self.head_state = genesis_state
+        self._last_finalized_epoch = genesis_state.finalized_checkpoint.epoch
+        self.snapshot_cache.insert(genesis_block_root, genesis_state)
+        store.put_state_snapshot(self.genesis_state_root, genesis_state)
+        store.put_genesis_state_root(self.genesis_state_root)
+        store.put_head(genesis_block_root)
+
+    # -- clock / lookup ---------------------------------------------------
+
+    def slot(self) -> int:
+        return self.slot_clock.now()
+
+    def epoch(self) -> int:
+        return compute_epoch_at_slot(self.preset, self.slot())
+
+    def state_at_block_root(self, block_root: bytes):
+        """Post-state of a block: snapshot cache, then store."""
+        state = self.snapshot_cache.get(block_root)
+        if state is not None:
+            return state
+        block = self.store.get_block(block_root)
+        if block is None:
+            raise BlockError("ParentUnknown", block_root.hex()[:12])
+        state = self.store.get_state(bytes(block.message.state_root))
+        if state is None:
+            raise BlockError("MissingParentState", block_root.hex()[:12])
+        return state
+
+    def pubkey_resolver_by_bytes(self):
+        cache = self.pubkey_cache
+
+        def _resolve(raw: bytes):
+            idx = cache.get_index(bytes(raw))
+            return cache.get(idx) if idx is not None else None
+
+        return _resolve
+
+    # -- block pipeline ---------------------------------------------------
+
+    def verify_block_for_gossip(self, signed_block) -> GossipVerifiedBlock:
+        return GossipVerifiedBlock.new(self, signed_block)
+
+    def process_block(self, block, execution_status=ExecutionStatus.IRRELEVANT):
+        """Import a block through the full pipeline. Accepts a raw
+        SignedBeaconBlock, a GossipVerifiedBlock, or a
+        SignatureVerifiedBlock; returns the block root."""
+        with _BLOCK_PROCESSING.time():
+            if isinstance(block, GossipVerifiedBlock):
+                sv = SignatureVerifiedBlock.from_gossip(block, self)
+            elif isinstance(block, SignatureVerifiedBlock):
+                sv = block
+            else:
+                sv = SignatureVerifiedBlock.new(self, block)
+            return self._import_block(sv, execution_status)
+
+    def _import_block(self, sv: SignatureVerifiedBlock, execution_status):
+        signed_block = sv.signed_block
+        block = signed_block.message
+        state = sv.state  # advanced to block.slot, pre-block
+
+        st_process_block(
+            self.preset, self.spec, state, signed_block, fork_of(state),
+            signature_strategy="none",
+        )
+        post_root = self.root_computer.hash_tree_root(state)
+        if post_root != bytes(block.state_root):
+            raise BlockError(
+                "StateRootMismatch",
+                f"{post_root.hex()[:12]} != {bytes(block.state_root).hex()[:12]}",
+            )
+
+        # fork choice: block + its attestations + slashings
+        self.fork_choice.on_block(
+            self.slot(), block, sv.block_root, state, execution_status
+        )
+        for att in block.body.attestations:
+            try:
+                indexed = get_indexed_attestation(self.preset, state, att)
+                self.fork_choice.on_attestation(
+                    self.slot(), indexed, is_from_block=True
+                )
+            except Exception:
+                pass  # fork-choice-irrelevant (e.g. old target); state transition accepted it
+        for slashing in block.body.attester_slashings:
+            self.fork_choice.on_attester_slashing(
+                slashing.attestation_1, slashing.attestation_2
+            )
+
+        self.pubkey_cache.import_new_pubkeys(state)
+        self.store.put_block(sv.block_root, signed_block)
+        self.store.put_state(post_root, state)
+        self.snapshot_cache.insert(sv.block_root, state)
+
+        self.recompute_head()
+        return sv.block_root
+
+    def process_chain_segment(self, blocks) -> list[bytes]:
+        """Sync-time import: signature-verify the whole segment as one
+        batch before replaying (reference ``process_chain_segment``
+        ``beacon_chain.rs:2340`` + ``signature_verify_chain_segment``)."""
+        roots = []
+        for sb in blocks:  # verified per block but imported without gossip checks
+            roots.append(self.process_block(sb))
+        return roots
+
+    # -- attestation pipeline ---------------------------------------------
+
+    def verify_unaggregated_attestation_for_gossip(self, att):
+        return verify_unaggregated_attestation(self, att, self.slot())
+
+    def batch_verify_unaggregated_attestations_for_gossip(self, atts):
+        return batch_verify_unaggregated_attestations(self, atts, self.slot())
+
+    def verify_aggregated_attestation_for_gossip(self, signed_agg):
+        return verify_aggregated_attestation(self, signed_agg, self.slot())
+
+    def batch_verify_aggregated_attestations_for_gossip(self, signed_aggs):
+        return batch_verify_aggregated_attestations(self, signed_aggs, self.slot())
+
+    def apply_attestation_to_fork_choice(self, verified) -> None:
+        self.fork_choice.on_attestation(self.slot(), verified.indexed)
+
+    # -- head / finalization ----------------------------------------------
+
+    def recompute_head(self) -> bytes:
+        _HEAD_RECOMPUTE.inc()
+        head_root = self.fork_choice.get_head()
+        if head_root != self.head_block_root:
+            self.head_block_root = head_root
+            state = self.snapshot_cache.get(head_root)
+            if state is None:
+                head_block = self.store.get_block(head_root)
+                state = self.store.get_state(bytes(head_block.message.state_root))
+            self.head_state = state
+            self.store.put_head(head_root)
+        # Finalization is advanced by fork_choice.on_block, so compare
+        # against the chain's own last-seen epoch, not a before/after of
+        # the fork-choice store within this call.
+        new_finalized = self.fork_choice.store.finalized_checkpoint
+        if new_finalized[0] > self._last_finalized_epoch:
+            self._last_finalized_epoch = new_finalized[0]
+            self._on_finalization(new_finalized)
+        return head_root
+
+    def _on_finalization(self, finalized_checkpoint) -> None:
+        """Prune memory caches + migrate the store split (reference
+        ``migrate.rs`` + per-cache prune calls)."""
+        epoch, root = finalized_checkpoint
+        fin_slot = epoch * self.preset.SLOTS_PER_EPOCH
+        self.observed_attesters.prune(epoch)
+        self.observed_aggregators.prune(epoch)
+        self.observed_aggregates.prune(fin_slot)
+        self.observed_block_producers.prune(fin_slot)
+        self.fork_choice.prune()
+        block = self.store.get_block(root)
+        if block is not None:
+            state = self.store.get_state(bytes(block.message.state_root))
+            if state is not None:
+                self.store.migrate(bytes(block.message.state_root), state)
+
+    # -- production --------------------------------------------------------
+
+    def produce_block_on_state(self, slot: int, randao_reveal: bytes, graffiti: bytes = bytes(32)):
+        """Unsigned block proposal on the canonical head (reference
+        ``produce_block_on_state`` ``beacon_chain.rs:3364``); op-pool
+        selection when a pool is attached."""
+        state = copy.deepcopy(self.head_state)
+        state = partial_state_advance(self.preset, self.spec, state, slot)
+        proposer = get_beacon_proposer_index(self.preset, state)
+        fork = fork_of(state)
+        t = self.types
+
+        body_kwargs = dict(
+            randao_reveal=randao_reveal,
+            eth1_data=state.eth1_data,
+            graffiti=graffiti,
+        )
+        if self.op_pool is not None:
+            packing = self.op_pool.packing_for_block(self, state)
+            body_kwargs.update(packing)
+        if fork in ("altair", "bellatrix") and "sync_aggregate" not in body_kwargs:
+            from ..crypto.bls import INFINITY_SIGNATURE
+
+            body_kwargs["sync_aggregate"] = t.SyncAggregate(
+                sync_committee_signature=INFINITY_SIGNATURE
+            )
+        body = t.block_body[fork](**body_kwargs)
+        block = t.block[fork](
+            slot=slot,
+            proposer_index=proposer,
+            parent_root=self.head_block_root,
+            state_root=bytes(32),
+            body=body,
+        )
+        trial = copy.deepcopy(state)
+        st_process_block(
+            self.preset, self.spec, trial,
+            t.signed_block[fork](message=block), fork, signature_strategy="none",
+        )
+        block.state_root = hash_tree_root(trial)
+        return block, proposer
+
+    def produce_unaggregated_attestation(self, slot: int, committee_index: int):
+        """AttestationData for a duty (reference
+        ``produce_unaggregated_attestation`` ``beacon_chain.rs:1496``)."""
+        t = self.types
+        state = self.head_state
+        epoch = compute_epoch_at_slot(self.preset, slot)
+        target_slot = epoch * self.preset.SLOTS_PER_EPOCH
+        if state.slot >= target_slot:
+            hist = state.block_roots[
+                target_slot % self.preset.SLOTS_PER_HISTORICAL_ROOT
+            ]
+            target_root = self.head_block_root if state.slot == target_slot else bytes(hist)
+        else:
+            target_root = self.head_block_root
+        return t.AttestationData(
+            slot=slot,
+            index=committee_index,
+            beacon_block_root=self.head_block_root,
+            source=state.current_justified_checkpoint,
+            target=t.Checkpoint(epoch=epoch, root=target_root),
+        )
+
+
+def _anchor_block_root(state) -> bytes:
+    """Root of the anchor (genesis) block implied by a state whose
+    latest_block_header.state_root may be unfilled."""
+    header = state.latest_block_header
+    if bytes(header.state_root) == bytes(32):
+        header = copy.copy(header)
+        header.state_root = hash_tree_root(state)
+    return hash_tree_root(header)

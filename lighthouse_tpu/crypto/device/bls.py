@@ -81,9 +81,9 @@ def _bits64(r):
     return jnp.concatenate([hb, lb], axis=-1)
 
 
-def verify_batch_fn(pk_xy, pk_mask, sig_xy, msg_xy, rand_bits, set_mask):
-    """The one-shot device program. Returns a scalar bool: True iff every
-    real lane's set verifies (random-linear-combination soundness)."""
+def _verify_core(pk_xy, pk_mask, sig_xy, msg_aff, rand_bits, set_mask):
+    """Shared verification core; ``msg_aff = (x, y, inf)`` are the hashed
+    messages in G2 affine, one per lane."""
     B = pk_xy.shape[0]
 
     # --- aggregate pubkeys per set (masked sum over the K axis) ---------
@@ -118,13 +118,15 @@ def verify_batch_fn(pk_xy, pk_mask, sig_xy, msg_xy, rand_bits, set_mask):
     g1_y = jnp.concatenate([pk_y, fp.const(_NEG_G1[1])[None]], axis=0)
     g1_inf = jnp.concatenate([pk_inf, jnp.zeros((1,), bool)], axis=0)
 
+    msg_x, msg_y, msg_inf = msg_aff
     acc_x, acc_y, acc_inf = curve.to_affine(fp2, sig_acc)
-    g2_x = jnp.concatenate([msg_xy[:, 0], acc_x[None]], axis=0)
-    g2_y = jnp.concatenate([msg_xy[:, 1], acc_y[None]], axis=0)
-    g2_inf = jnp.concatenate([jnp.zeros((B,), bool), acc_inf[None]], axis=0)
+    g2_x = jnp.concatenate([msg_x, acc_x[None]], axis=0)
+    g2_y = jnp.concatenate([msg_y, acc_y[None]], axis=0)
+    g2_inf = jnp.concatenate([msg_inf, acc_inf[None]], axis=0)
 
-    out = pairing.multi_pairing((g1_x, g1_y, g1_inf), (g2_x, g2_y, g2_inf))
-    pairing_ok = tower.is_one(out)
+    pairing_ok = pairing.multi_pairing_is_one(
+        (g1_x, g1_y, g1_inf), (g2_x, g2_y, g2_inf)
+    )
 
     # a real lane whose aggregate pubkey degenerated to infinity (e.g. sum
     # of pubkeys cancels) must fail rather than silently contribute 1
@@ -133,7 +135,34 @@ def verify_batch_fn(pk_xy, pk_mask, sig_xy, msg_xy, rand_bits, set_mask):
     return pairing_ok & subgroup_ok & ~agg_inf_bad
 
 
+def verify_batch_fn(pk_xy, pk_mask, sig_xy, msg_xy, rand_bits, set_mask):
+    """One-shot device program over pre-hashed message points. Returns a
+    scalar bool: True iff every real lane's set verifies."""
+    B = pk_xy.shape[0]
+    msg_aff = (msg_xy[:, 0], msg_xy[:, 1], jnp.zeros((B,), bool))
+    return _verify_core(pk_xy, pk_mask, sig_xy, msg_aff, rand_bits, set_mask)
+
+
+def verify_batch_hashed_fn(pk_xy, pk_mask, sig_xy, msg_u, msg_idx, rand_bits, set_mask):
+    """END-TO-END device program: raw hash_to_field outputs in, verdict
+    out. ``msg_u`` int32[M, 2, 2, NL] holds the unique messages' field
+    elements; ``msg_idx`` int32[B] maps each lane to its message — dedup
+    mirrors the reference's per-distinct-AttestationData hashing, but the
+    hashing itself is the batched device map (see ``device/htc.py``)."""
+    from . import htc
+
+    msg_pts = htc.map_to_g2(msg_u)                       # [M] Jacobian
+    mx, my, minf = curve.to_affine(fp2, msg_pts)
+    msg_aff = (
+        jnp.take(mx, msg_idx, axis=0),
+        jnp.take(my, msg_idx, axis=0),
+        jnp.take(minf, msg_idx, axis=0),
+    )
+    return _verify_core(pk_xy, pk_mask, sig_xy, msg_aff, rand_bits, set_mask)
+
+
 verify_batch = jax.jit(verify_batch_fn)
+verify_batch_hashed = jax.jit(verify_batch_hashed_fn)
 
 
 # ---------------------------------------------------------------------------
@@ -154,34 +183,20 @@ def _rand_scalar_words() -> tuple[int, int]:
             return (r >> 32) & 0xFFFFFFFF, r & 0xFFFFFFFF
 
 
-def pack_signature_sets(sets, pad_b: int | None = None, pad_k: int | None = None):
-    """Host-side batch assembly: (sig_point, [pk_points], message) triples ->
-    the fixed-shape device arrays of :func:`verify_batch_fn`. Sets must be
-    pre-screened (non-empty, non-infinity signature). Shapes are padded to
-    bucket sizes to bound jit recompiles."""
-    sets = list(sets)
-    B = pad_b or _round_up(len(sets))
-    K = pad_k or _round_up(max(len(pks) for _, pks, _ in sets))
-
+def _pack_common(sets, B: int, K: int):
+    """Shared per-set packing: pubkeys, signatures, randomness, mask —
+    used by both message-point and hashed packers."""
     pk_xy = np.zeros((B, K, 2, fp.NL), np.int32)
     pk_mask = np.zeros((B, K), bool)
     sig_xy = np.zeros((B, 2, 2, fp.NL), np.int32)
-    msg_xy = np.zeros((B, 2, 2, fp.NL), np.int32)
     rand = np.zeros((B, 2), np.int32)
     set_mask = np.zeros((B,), bool)
-
-    msg_cache: dict[bytes, np.ndarray] = {}
-    for i, (sig, pks, msg) in enumerate(sets):
+    for i, (sig, pks, _msg) in enumerate(sets):
         xy, _ = curve.pack_g1(pks)
         pk_xy[i, : len(pks)] = xy
         pk_mask[i, : len(pks)] = True
         sxy, _ = curve.pack_g2([sig])
         sig_xy[i] = sxy[0]
-        hxy = msg_cache.get(msg)
-        if hxy is None:
-            hxy = curve.pack_g2([hash_to_g2(msg, DST)])[0][0]
-            msg_cache[msg] = hxy
-        msg_xy[i] = hxy
         hi, lo = _rand_scalar_words()
         rand[i] = (np.int32(np.uint32(hi)), np.int32(np.uint32(lo)))
         set_mask[i] = True
@@ -193,7 +208,44 @@ def pack_signature_sets(sets, pad_b: int | None = None, pad_k: int | None = None
 
         gxy, _ = curve.pack_g2([g2_generator()])
         sig_xy[len(sets):] = gxy[0]
-        msg_xy[len(sets):] = gxy[0]
+    return pk_xy, pk_mask, sig_xy, rand, set_mask
+
+
+def _dedup_messages(messages, pad_m: int | None):
+    """-> (unique-message list padded to M, per-item index array)."""
+    uniq: dict[bytes, int] = {}
+    idx = np.zeros((len(messages),), np.int32)
+    for i, m in enumerate(messages):
+        idx[i] = uniq.setdefault(bytes(m), len(uniq))
+    M = pad_m or _round_up(len(uniq))
+    assert len(uniq) <= M, (
+        f"pad_m={M} smaller than {len(uniq)} distinct messages"
+    )
+    msgs = sorted(uniq, key=uniq.get) + [b""] * (M - len(uniq))
+    return msgs, idx
+
+
+def pack_signature_sets(sets, pad_b: int | None = None, pad_k: int | None = None):
+    """Host-side batch assembly: (sig_point, [pk_points], message) triples ->
+    the fixed-shape device arrays of :func:`verify_batch_fn`. Sets must be
+    pre-screened (non-empty, non-infinity signature). Shapes are padded to
+    bucket sizes to bound jit recompiles."""
+    sets = list(sets)
+    B = pad_b or _round_up(len(sets))
+    K = pad_k or _round_up(max(len(pks) for _, pks, _ in sets))
+    pk_xy, pk_mask, sig_xy, rand, set_mask = _pack_common(sets, B, K)
+
+    msg_xy = np.zeros((B, 2, 2, fp.NL), np.int32)
+    msg_cache: dict[bytes, np.ndarray] = {}
+    for i, (_sig, _pks, msg) in enumerate(sets):
+        hxy = msg_cache.get(msg)
+        if hxy is None:
+            hxy = curve.pack_g2([hash_to_g2(msg, DST)])[0][0]
+            msg_cache[msg] = hxy
+        msg_xy[i] = hxy
+    if B > len(sets):
+        # same placeholder as the padding signature lanes
+        msg_xy[len(sets):] = sig_xy[len(sets)]
 
     return (
         jnp.asarray(pk_xy),
@@ -205,11 +257,50 @@ def pack_signature_sets(sets, pad_b: int | None = None, pad_k: int | None = None
     )
 
 
+def pack_signature_sets_hashed(
+    sets, pad_b: int | None = None, pad_k: int | None = None,
+    pad_m: int | None = None,
+):
+    """End-to-end packing: like :func:`pack_signature_sets` but messages
+    stay raw — the host computes only hash_to_field u-values (native
+    SHA-256); the curve mapping runs on device inside
+    :func:`verify_batch_hashed_fn`. This removes the 285 ms/message
+    pure-Python ``hash_to_g2`` from the hot path (VERDICT weakness #2)."""
+    from . import htc
+
+    sets = list(sets)
+    B = pad_b or _round_up(len(sets))
+    K = pad_k or _round_up(max(len(pks) for _, pks, _ in sets))
+    pk_xy, pk_mask, sig_xy, rand, set_mask = _pack_common(sets, B, K)
+
+    msgs, idx = _dedup_messages([m for _, _, m in sets], pad_m)
+    msg_idx = np.zeros((B,), np.int32)
+    msg_idx[: len(sets)] = idx
+    msg_u = htc.messages_to_u(msgs, DST)
+
+    return (
+        jnp.asarray(pk_xy),
+        jnp.asarray(pk_mask),
+        jnp.asarray(sig_xy),
+        jnp.asarray(msg_u),
+        jnp.asarray(msg_idx),
+        jnp.asarray(rand),
+        jnp.asarray(set_mask),
+    )
+
+
 class TpuBackend:
     """Runtime backend ``"tpu"`` (see crypto/backend.py). Presents the same
     protocol as the CPU oracle backend; internally packs fixed-shape
     batches and calls the jitted device program (compile cache keyed on
-    padded (B, K) bucket shape)."""
+    padded (B, K, M) bucket shape).
+
+    Pubkey subgroup checks are NOT repeated here: every ``PublicKey``
+    enters the system through ``deserialize`` (KeyValidate — infinity +
+    subgroup), mirroring the reference's decompress-once
+    ``ValidatorPubkeyCache`` admission (``validator_pubkey_cache.rs:79``);
+    the device program still rejects an aggregate that degenerates to
+    infinity."""
 
     name = "tpu"
 
@@ -224,13 +315,13 @@ class TpuBackend:
                 return False
             if any(pk.is_infinity() for pk in pks):
                 return False
-        out = verify_batch(*pack_signature_sets(sets))
+        out = verify_batch_hashed(*pack_signature_sets_hashed(sets))
         return bool(out)
 
     # -- single-set entry points (same device program, B=1 semantics) ----
 
     def verify(self, pk, message, sig) -> bool:
-        if pk.is_infinity() or not pk.in_subgroup():
+        if pk.is_infinity():
             return False
         return self._verify_one(sig, [pk], message, aggregate=False)
 
@@ -238,38 +329,32 @@ class TpuBackend:
         pks = list(pks)
         if not pks:
             return False
-        # Parity with the CPU backend: the aggregated pubkey must be a
-        # non-infinity subgroup point (cpu/bls.py fast_aggregate_verify ->
-        # verify pk checks).
-        agg = pks[0]
-        for p in pks[1:]:
-            agg = agg + p
-        if agg.is_infinity() or not agg.in_subgroup():
-            return False
+        # Aggregation happens on device (masked sum); an aggregate that
+        # degenerates to infinity fails inside the device program.
         return self._verify_one(sig, pks, message, aggregate=True)
 
     def aggregate_verify(self, pks, messages, sig) -> bool:
         """One signature over per-pubkey messages: prod e(pk_i, H(m_i)) *
-        e(-g1, sig) == 1 with a subgroup-checked signature."""
+        e(-g1, sig) == 1 with a subgroup-checked signature. Message
+        hashing runs on device (htc.map_to_g2)."""
+        from . import htc
+
         pks, messages = list(pks), list(messages)
         if not pks or len(pks) != len(messages):
             return False
-        # Parity with the CPU backend: every pubkey non-infinity + subgroup.
-        if any(pk.is_infinity() or not pk.in_subgroup() for pk in pks):
+        if any(pk.is_infinity() for pk in pks):
             return False
         n = len(pks)
         Bn = _round_up(n)
         pk_xy = np.zeros((Bn, 2, fp.NL), np.int32)
         pk_inf = np.ones((Bn,), bool)
-        msg_xy = np.zeros((Bn, 2, 2, fp.NL), np.int32)
-        msg_inf = np.ones((Bn,), bool)
         xy, _ = curve.pack_g1(pks)
         pk_xy[:n] = xy
         pk_inf[:n] = False
-        hs = [hash_to_g2(m, DST) for m in messages]
-        hxy, _ = curve.pack_g2(hs)
-        msg_xy[:n] = hxy
-        msg_inf[:n] = False
+        msgs, idx = _dedup_messages(messages, None)
+        msg_idx = np.zeros((Bn,), np.int32)
+        msg_idx[:n] = idx
+        msg_u = htc.messages_to_u(msgs, DST)
 
         sxy, s_inf = curve.pack_g2([sig])
         if s_inf[0]:
@@ -278,8 +363,8 @@ class TpuBackend:
             _aggregate_verify_device(
                 jnp.asarray(pk_xy),
                 jnp.asarray(pk_inf),
-                jnp.asarray(msg_xy),
-                jnp.asarray(msg_inf),
+                jnp.asarray(msg_u),
+                jnp.asarray(msg_idx),
                 jnp.asarray(sxy[0]),
             )
         )
@@ -291,17 +376,27 @@ class TpuBackend:
 
 
 @jax.jit
-def _aggregate_verify_device(pk_xy, pk_inf, msg_xy, msg_inf, sig_xy):
+def _aggregate_verify_device(pk_xy, pk_inf, msg_u, msg_idx, sig_xy):
+    from . import htc
+
     sig_pt = curve.from_affine(fp2, sig_xy[0], sig_xy[1])
     sub_ok = g2_in_subgroup(sig_pt)
+
+    msg_pts = htc.map_to_g2(msg_u)
+    mx, my, minf = curve.to_affine(fp2, msg_pts)
 
     g1_x = jnp.concatenate([pk_xy[:, 0], fp.const(_NEG_G1[0])[None]], axis=0)
     g1_y = jnp.concatenate([pk_xy[:, 1], fp.const(_NEG_G1[1])[None]], axis=0)
     g1_inf = jnp.concatenate([pk_inf, jnp.zeros((1,), bool)], axis=0)
     sx, sy, sinf = curve.to_affine(fp2, sig_pt)
-    g2_x = jnp.concatenate([msg_xy[:, 0], sx[None]], axis=0)
-    g2_y = jnp.concatenate([msg_xy[:, 1], sy[None]], axis=0)
-    g2_inf = jnp.concatenate([msg_inf, sinf[None]], axis=0)
+    g2_x = jnp.concatenate([jnp.take(mx, msg_idx, axis=0), sx[None]], axis=0)
+    g2_y = jnp.concatenate([jnp.take(my, msg_idx, axis=0), sy[None]], axis=0)
+    # a padding pk lane is already infinity on the G1 side; message side
+    # needs no mask
+    g2_inf = jnp.concatenate(
+        [jnp.take(minf, msg_idx, axis=0), sinf[None]], axis=0
+    )
 
-    out = pairing.multi_pairing((g1_x, g1_y, g1_inf), (g2_x, g2_y, g2_inf))
-    return tower.is_one(out) & sub_ok
+    return pairing.multi_pairing_is_one(
+        (g1_x, g1_y, g1_inf), (g2_x, g2_y, g2_inf)
+    ) & sub_ok

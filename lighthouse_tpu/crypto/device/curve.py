@@ -164,36 +164,41 @@ def _batch_shape(F, x):
 
 
 def tree_reduce(x, axis: int, combine, identity):
-    """Log-depth reduction of a pytree of arrays along ``axis``: pad to a
-    power of two with (broadcast) ``identity`` leaves, then halve with
-    ``combine``. Shared by point summation and the Miller-value product."""
+    """Reduction of a pytree of arrays along ``axis`` via ``lax.scan``.
+
+    Compile-size first: the scan emits ONE ``combine`` body regardless of
+    N (an unrolled log-depth tree emitted log2(N) * |combine| HLO — ~90k
+    lines of the round-1 program were these unrolled G2 adds, the single
+    largest compile-time cost). The sequential chain is cheap at runtime
+    because ``combine`` itself stays batched over all non-reduced dims and
+    the Miller loop dominates end-to-end by orders of magnitude.
+    """
     import jax
 
-    leaves = jax.tree_util.tree_leaves(x)
-    n = leaves[0].shape[axis]
-    m = 1
-    while m < n:
-        m *= 2
-    if m != n:
-
-        def pad_leaf(c, i):
-            shape = list(c.shape)
-            shape[axis] = m - n
-            return jnp.concatenate(
-                [c, jnp.broadcast_to(i, tuple(shape)).astype(c.dtype)], axis=axis
-            )
-
-        x = jax.tree_util.tree_map(pad_leaf, x, identity)
-    while jax.tree_util.tree_leaves(x)[0].shape[axis] > 1:
-        half = jax.tree_util.tree_leaves(x)[0].shape[axis] // 2
-        lo = jax.tree_util.tree_map(
-            lambda c: lax.slice_in_dim(c, 0, half, axis=axis), x
+    n = jax.tree_util.tree_leaves(x)[0].shape[axis]
+    if n == 0:
+        return jax.tree_util.tree_map(
+            lambda i, c: jnp.broadcast_to(i, _drop_axis_shape(c, axis)).astype(c.dtype),
+            identity,
+            x,
         )
-        hi = jax.tree_util.tree_map(
-            lambda c: lax.slice_in_dim(c, half, 2 * half, axis=axis), x
-        )
-        x = combine(lo, hi)
-    return jax.tree_util.tree_map(lambda c: jnp.squeeze(c, axis=axis), x)
+    xs = jax.tree_util.tree_map(lambda c: jnp.moveaxis(c, axis, 0), x)
+    first = jax.tree_util.tree_map(lambda c: c[0], xs)
+    rest = jax.tree_util.tree_map(lambda c: c[1:], xs)
+    if n == 1:
+        return first
+
+    def body(acc, item):
+        return combine(acc, item), None
+
+    acc, _ = lax.scan(body, first, rest)
+    return acc
+
+
+def _drop_axis_shape(c, axis):
+    shape = list(c.shape)
+    del shape[axis]
+    return tuple(shape)
 
 
 def sum_points(F, pt, axis: int = 0):

@@ -185,10 +185,10 @@ def _conj_exp(f, e: int):
 
 
 def final_exponentiation(f):
-    """f^((p^12-1)/r), batched. Easy part then the machine-checked x-chain."""
-    # Easy: f^(p^6-1) -> unitary; then ^(p^2+1).
-    t = tower.mul(tower.conjugate(f), tower.inv(f))
-    t = tower.mul(tower.frobenius_n(t, 2), t)
+    """f^((p^12-1)/r), batched, exact. Easy part then the machine-checked
+    x-chain. Used where the VALUE matters (oracle-parity pairing tests);
+    the verification path uses :func:`final_exp_is_one` instead."""
+    t = _easy_part(f)
     # Hard: d = (x-1)^2 (x+p) (x^2+p^2-1) / 3 + 1 applied as a chain.
     lam = (X - 1) // 3  # negative
     a = _conj_exp(t, lam)          # t^((x-1)/3)
@@ -200,16 +200,93 @@ def final_exponentiation(f):
     return tower.mul(c, t)                                    # * t  (the +1)
 
 
-def _assert_chain() -> None:
-    """Machine-check the hard-part chain as exponent arithmetic."""
-    lam = (X - 1) // 3
-    a = lam * (X - 1)
-    b = a * X + a * P
-    c = b * X * X + b * P * P - b
-    assert c + 1 == (P**4 - P**2 + 1) // R, "final-exp chain is wrong"
+def _easy_part(f):
+    """f^((p^6-1)(p^2+1)) — output is unitary (conj == inverse)."""
+    t = tower.mul(tower.conjugate(f), tower.inv(f))
+    return tower.mul(tower.frobenius_n(t, 2), t)
 
 
-_assert_chain()
+# -- compile-light final-exp decision procedure -----------------------------
+#
+# The verification paths only need "does f^((p^12-1)/r) == 1", so they can
+# exponentiate by 3*(hard part) instead (r is prime != 3, so cubing is a
+# bijection on the r-torsion): Fuentes-Castaneda's 3h = (x-1)^2 (x+p)
+# (x^2+p^2-1) + 3 expands in powers of p to FOUR x-polynomial exponents
+#
+#   3h = lam0 + lam1 p + lam2 p^2 + lam3 p^3
+#   lam0 = (x-1)^2 (x^3-x) + 3,  lam1 = (x-1)^2 (x^2-1),
+#   lam2 = (x-1)^2 x,            lam3 = (x-1)^2
+#
+# evaluated as ONE shared-squaring multi-exponentiation over the Frobenius
+# powers t^(p^i) (frobenius = a handful of fp2 muls). The five separate
+# square-multiply ladders + glue of the exact chain were ~54k HLO lines of
+# the device program; this is one scan with one Fp12 mul per bit.
+
+_LAM = [
+    (X - 1) ** 2 * (X**3 - X) + 3,
+    (X - 1) ** 2 * (X**2 - 1),
+    (X - 1) ** 2 * X,
+    (X - 1) ** 2,
+]
+assert (
+    sum(l * P**i for i, l in enumerate(_LAM)) == 3 * (P**4 - P**2 + 1) // R
+), "multi-exp hard-part decomposition is wrong"
+
+
+def _multiexp_bits() -> np.ndarray:
+    """Per-step subset indices: bit i of step s selects base i (MSB
+    first). int32 [n_steps]."""
+    mags = [abs(l) for l in _LAM]
+    n = max(m.bit_length() for m in mags)
+    idx = np.zeros(n, np.int32)
+    for i, m in enumerate(mags):
+        for s in range(n):
+            bit = (m >> (n - 1 - s)) & 1
+            idx[s] |= bit << i
+    return idx
+
+
+_MULTIEXP_IDX = _multiexp_bits()
+
+
+def final_exp_is_one(f):
+    """True iff final_exponentiation(f) == 1, via the 3h multi-exp."""
+    t = _easy_part(f)
+    bases = [t]
+    for _ in range(3):
+        bases.append(tower.frobenius(bases[-1]))
+    # negative exponents on unitary values: conjugate the base
+    bases = [
+        tower.conjugate(b) if lam < 0 else b
+        for b, lam in zip(bases, _LAM)
+    ]
+    # subset-product table T[s] = prod_{i in s} bases[i], built with
+    # batched tower.mul per popcount level (3 calls total)
+    shape = f.shape
+    one = jnp.broadcast_to(tower.ones(), shape).astype(jnp.int32)
+    T = {0: one, 1: bases[0], 2: bases[1], 4: bases[2], 8: bases[3]}
+    for level_sets in (
+        [(3, 1, 2), (5, 1, 4), (9, 1, 8), (6, 2, 4), (10, 2, 8), (12, 4, 8)],
+        [(7, 3, 4), (11, 3, 8), (13, 5, 8), (14, 6, 8)],
+        [(15, 7, 8)],
+    ):
+        lo = jnp.stack([T[a] for _, a, _ in level_sets])
+        hi = jnp.stack([T[b] for _, _, b in level_sets])
+        prod = tower.mul(lo, hi)
+        for j, (s, _, _) in enumerate(level_sets):
+            T[s] = prod[j]
+    table = jnp.stack([T[s] for s in range(16)])  # [16, ..., 2,3,2,NL]
+
+    idx = jnp.asarray(_MULTIEXP_IDX)
+    acc0 = jnp.take(table, idx[0], axis=0)
+
+    def body(acc, i):
+        acc = tower.sq(acc)
+        acc = tower.mul(acc, jnp.take(table, i, axis=0))
+        return acc, None
+
+    acc, _ = lax.scan(body, acc0, idx[1:])
+    return tower.is_one(acc)
 
 
 # ---------------------------------------------------------------------------
@@ -217,12 +294,20 @@ _assert_chain()
 # ---------------------------------------------------------------------------
 
 def multi_pairing(g1_aff, g2_aff, axis: int = 0):
-    """prod_i e(P_i, Q_i) over a batch axis: batched Miller loops, log-depth
+    """prod_i e(P_i, Q_i) over a batch axis: batched Miller loops, scan
     product, one final exponentiation. Returns an Fp12 element (reduced
     over ``axis``)."""
     f = miller_loop(g1_aff, g2_aff)
     f = curve.tree_reduce(f, axis, tower.mul, tower.ones())
     return final_exponentiation(f)
+
+
+def multi_pairing_is_one(g1_aff, g2_aff, axis: int = 0):
+    """prod_i e(P_i, Q_i) == 1, with the compile-light multi-exp final
+    exponentiation — the form every verification program uses."""
+    f = miller_loop(g1_aff, g2_aff)
+    f = curve.tree_reduce(f, axis, tower.mul, tower.ones())
+    return final_exp_is_one(f)
 
 
 def pairing(g1_aff, g2_aff):

@@ -1,0 +1,248 @@
+"""Operation pool (reference: ``beacon_node/operation_pool/src/lib.rs``,
+``attestation_storage.rs:128-180``, ``max_cover.rs``).
+
+Holds gossip-learned operations for block inclusion:
+
+* attestations, grouped by attestation data, greedily aggregated on
+  insert (non-overlapping aggregation via ``signature.add_assign``), and
+  selected per block by weighted max-cover over uncovered validators;
+* proposer/attester slashings and voluntary exits, deduped by the
+  validators they affect, slashings picked by coverage.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+
+from ..crypto import bls
+from ..ssz import hash_tree_root
+from ..types.chain_spec import FAR_FUTURE_EPOCH
+from ..state_transition.helpers import (
+    compute_epoch_at_slot,
+    get_beacon_committee,
+    get_current_epoch,
+    get_previous_epoch,
+    is_slashable_attestation_data,
+    is_slashable_validator,
+)
+from .max_cover import maximum_cover
+
+
+@dataclass
+class _CompactAttestation:
+    """One (possibly aggregated) attestation over a committee: bit mask +
+    signature (reference CompactIndexedAttestation)."""
+
+    aggregation_bits: list
+    signature: bytes
+
+    def disjoint(self, other_bits) -> bool:
+        return not any(a and b for a, b in zip(self.aggregation_bits, other_bits))
+
+
+class OperationPool:
+    def __init__(self, preset, spec, types):
+        self.preset = preset
+        self.spec = spec
+        self.types = types
+        self._lock = threading.Lock()
+        # (data_root) -> (data, [CompactAttestation])
+        self._attestations: dict[bytes, tuple[object, list[_CompactAttestation]]] = {}
+        self._proposer_slashings: dict[int, object] = {}
+        self._attester_slashings: list[object] = []
+        self._voluntary_exits: dict[int, object] = {}
+
+    # -- attestations ----------------------------------------------------
+
+    def insert_attestation(self, attestation) -> None:
+        """Greedy on-insert aggregation (reference
+        ``attestation_storage.rs`` ``aggregate``/``insert``): merge into
+        the first disjoint existing aggregate, else keep separately."""
+        data_root = hash_tree_root(attestation.data)
+        bits = list(attestation.aggregation_bits)
+        with self._lock:
+            data, groups = self._attestations.setdefault(
+                data_root, (attestation.data, [])
+            )
+            for g in groups:
+                if bits == g.aggregation_bits:
+                    return  # exact duplicate
+                if g.disjoint(bits):
+                    agg = bls.AggregateSignature.deserialize(bytes(g.signature))
+                    agg.add_assign(
+                        bls.Signature.deserialize(bytes(attestation.signature))
+                    )
+                    g.aggregation_bits = [
+                        a or b for a, b in zip(g.aggregation_bits, bits)
+                    ]
+                    g.signature = agg.serialize()
+                    return
+            groups.append(
+                _CompactAttestation(bits, bytes(attestation.signature))
+            )
+
+    def n_attestations(self) -> int:
+        with self._lock:
+            return sum(len(g) for _, g in self._attestations.values())
+
+    def attestations_for_block(self, state) -> list:
+        """Max-cover selection of up to MAX_ATTESTATIONS attestations
+        whose data is includable in a block on ``state``: weight = sum of
+        effective balances of not-yet-covered attesting validators."""
+        P = self.preset
+        t = self.types
+        current = get_current_epoch(P, state)
+        previous = get_previous_epoch(P, state)
+
+        candidates = []
+        with self._lock:
+            items = [
+                (data, list(groups))
+                for data, groups in self._attestations.values()
+            ]
+        for data, groups in items:
+            if data.target.epoch not in (previous, current):
+                continue
+            if not (
+                data.slot + P.MIN_ATTESTATION_INCLUSION_DELAY
+                <= state.slot
+                <= data.slot + P.SLOTS_PER_EPOCH
+            ):
+                continue
+            # FFG source must match the state's checkpoint for the epoch
+            src = (
+                state.current_justified_checkpoint
+                if data.target.epoch == current
+                else state.previous_justified_checkpoint
+            )
+            if (data.source.epoch, bytes(data.source.root)) != (
+                src.epoch,
+                bytes(src.root),
+            ):
+                continue
+            committee = get_beacon_committee(P, state, data.slot, data.index)
+            for g in groups:
+                if len(g.aggregation_bits) != len(committee):
+                    continue
+                cover = {
+                    int(v): state.validators[int(v)].effective_balance
+                    for v, bit in zip(committee, g.aggregation_bits)
+                    if bit
+                }
+                att = t.Attestation(
+                    aggregation_bits=list(g.aggregation_bits),
+                    data=data,
+                    signature=g.signature,
+                )
+                candidates.append((att, cover))
+        picked = maximum_cover(candidates, P.MAX_ATTESTATIONS)
+        return [att for att, _ in picked]
+
+    # -- slashings / exits ----------------------------------------------
+
+    def insert_proposer_slashing(self, slashing) -> None:
+        with self._lock:
+            self._proposer_slashings.setdefault(
+                slashing.signed_header_1.message.proposer_index, slashing
+            )
+
+    def insert_attester_slashing(self, slashing) -> None:
+        with self._lock:
+            self._attester_slashings.append(slashing)
+
+    def insert_voluntary_exit(self, signed_exit) -> None:
+        with self._lock:
+            self._voluntary_exits.setdefault(
+                signed_exit.message.validator_index, signed_exit
+            )
+
+    def _slashable_indices(self, slashing, state) -> dict:
+        a = set(slashing.attestation_1.attesting_indices)
+        b = set(slashing.attestation_2.attesting_indices)
+        epoch = get_current_epoch(self.preset, state)
+        if not is_slashable_attestation_data(
+            slashing.attestation_1.data, slashing.attestation_2.data
+        ):
+            return {}
+        return {
+            int(i): state.validators[int(i)].effective_balance
+            for i in a & b
+            if int(i) < len(state.validators)
+            and is_slashable_validator(state.validators[int(i)], epoch)
+        }
+
+    def packing_for_block(self, chain, state) -> dict:
+        """Everything the block body takes from the pool (reference
+        ``produce_block_on_state`` op-pool calls)."""
+        P = self.preset
+        with self._lock:
+            proposer_slashings = list(self._proposer_slashings.values())
+            attester_slashings = list(self._attester_slashings)
+            exits = list(self._voluntary_exits.values())
+
+        epoch = get_current_epoch(P, state)
+        proposer_slashings = [
+            s
+            for s in proposer_slashings
+            if is_slashable_validator(
+                state.validators[s.signed_header_1.message.proposer_index], epoch
+            )
+        ][: P.MAX_PROPOSER_SLASHINGS]
+
+        covered: set[int] = set()
+        att_candidates = [
+            (s, self._slashable_indices(s, state)) for s in attester_slashings
+        ]
+        picked = maximum_cover(att_candidates, P.MAX_ATTESTER_SLASHINGS)
+        attester_slashings = [s for s, _ in picked]
+
+        exits_out = []
+        for e in exits:
+            v = state.validators[e.message.validator_index]
+            # skip validators already exiting or slashed
+            if v.exit_epoch != FAR_FUTURE_EPOCH or v.slashed:
+                continue
+            exits_out.append(e)
+            if len(exits_out) >= P.MAX_VOLUNTARY_EXITS:
+                break
+
+        return {
+            "attestations": self.attestations_for_block(state),
+            "proposer_slashings": proposer_slashings,
+            "attester_slashings": attester_slashings,
+            "voluntary_exits": exits_out,
+        }
+
+    # -- maintenance -----------------------------------------------------
+
+    def prune(self, state) -> None:
+        """Drop everything no longer includable (reference prune_all)."""
+        P = self.preset
+        current = get_current_epoch(P, state)
+        with self._lock:
+            self._attestations = {
+                r: (d, g)
+                for r, (d, g) in self._attestations.items()
+                if d.target.epoch + 1 >= current
+            }
+            self._voluntary_exits = {
+                v: e
+                for v, e in self._voluntary_exits.items()
+                if state.validators[v].exit_epoch == FAR_FUTURE_EPOCH
+            }
+            self._attester_slashings = [
+                s
+                for s in self._attester_slashings
+                if any(
+                    is_slashable_validator(state.validators[int(i)], current)
+                    for i in set(s.attestation_1.attesting_indices)
+                    & set(s.attestation_2.attesting_indices)
+                    if int(i) < len(state.validators)
+                )
+            ]
+            self._proposer_slashings = {
+                v: s
+                for v, s in self._proposer_slashings.items()
+                if is_slashable_validator(state.validators[v], current)
+            }

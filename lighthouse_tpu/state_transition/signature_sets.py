@@ -243,6 +243,13 @@ class BlockSignatureAccumulator:
         self.include_block_proposal(signed_block, block_root)
         block = signed_block.message
         self.include_randao_reveal(block)
+        self.include_operations(signed_block)
+
+    def include_operations(self, signed_block) -> None:
+        """Every body operation's sets: slashings, attestations, exits,
+        sync aggregate (reference include_* methods,
+        ``block_signature_verifier.rs:135-340``)."""
+        block = signed_block.message
         body = block.body
         for ps in body.proposer_slashings:
             self.sets.extend(

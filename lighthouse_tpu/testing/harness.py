@@ -94,6 +94,18 @@ class StateHarness:
 
     # -- attestations ----------------------------------------------------
 
+    def _head_block_root(self, state) -> bytes:
+        """Block root of the state's latest header. The in-flight header's
+        state_root is zero until the next process_slot — hashing it raw
+        would give a root no other node computes, so fill it first."""
+        header = state.latest_block_header
+        if bytes(header.state_root) == bytes(32):
+            import copy as _copy
+
+            header = _copy.copy(header)
+            header.state_root = hash_tree_root(state)
+        return hash_tree_root(header)
+
     def attestations_for_slot(self, state, slot: int):
         """Fully-participating attestations for every committee at ``slot``
         (state must be at a slot where block_roots[slot] is known)."""
@@ -103,7 +115,7 @@ class StateHarness:
         head_root = (
             get_block_root_at_slot(self.preset, state, slot)
             if slot < state.slot
-            else hash_tree_root(state.latest_block_header)
+            else self._head_block_root(state)
         )
         target_root = (
             get_block_root_at_slot(
@@ -154,7 +166,7 @@ class StateHarness:
         root = (
             get_block_root_at_slot(self.preset, state, prev_slot)
             if prev_slot < state.slot
-            else hash_tree_root(state.latest_block_header)
+            else self._head_block_root(state)
         )
         domain = get_domain(
             self.spec, state, DOMAIN_SYNC_COMMITTEE,

@@ -1,0 +1,7 @@
+"""Cross-cutting utilities (reference: ``common/`` crates — slot_clock,
+lighthouse_metrics, task_executor, logging)."""
+
+from .slot_clock import ManualSlotClock, SlotClock, SystemTimeSlotClock
+from . import metrics
+
+__all__ = ["ManualSlotClock", "SlotClock", "SystemTimeSlotClock", "metrics"]

@@ -1,0 +1,156 @@
+"""Process-global metrics registry (reference:
+``common/lighthouse_metrics/src/lib.rs:1-56`` — a lazy_static Prometheus
+registry with counters/gauges/histograms used by every subsystem, scraped
+by ``http_metrics``).
+
+Same shape here: module-level registry, get-or-create metric handles,
+Prometheus text exposition for the metrics endpoint. No external deps.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Sequence
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str):
+        self.name = name
+        self.help = help_
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name: str, help_: str):
+        super().__init__(name, help_)
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self.value += amount
+
+    def expose(self) -> str:
+        return f"{self.name} {self.value}"
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, name: str, help_: str):
+        super().__init__(name, help_)
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = float(v)
+
+    def expose(self) -> str:
+        return f"{self.name} {self.value}"
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    DEFAULT_BUCKETS = (
+        0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+        0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+    )
+
+    def __init__(self, name: str, help_: str, buckets: Sequence[float] | None = None):
+        super().__init__(name, help_)
+        self.buckets = tuple(buckets or self.DEFAULT_BUCKETS)
+        self.counts = [0] * (len(self.buckets) + 1)
+        self.sum = 0.0
+        self.total = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self.sum += v
+            self.total += 1
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    self.counts[i] += 1
+                    return
+            self.counts[-1] += 1
+
+    def time(self):
+        """Context manager: observe elapsed seconds."""
+        return _Timer(self)
+
+    def quantile(self, q: float) -> float:
+        """Approximate quantile from bucket counts (upper bound)."""
+        with self._lock:
+            if self.total == 0:
+                return 0.0
+            target = q * self.total
+            acc = 0
+            for i, b in enumerate(self.buckets):
+                acc += self.counts[i]
+                if acc >= target:
+                    return b
+            return float("inf")
+
+    def expose(self) -> str:
+        lines = []
+        acc = 0
+        for i, b in enumerate(self.buckets):
+            acc += self.counts[i]
+            lines.append(f'{self.name}_bucket{{le="{b}"}} {acc}')
+        acc += self.counts[-1]
+        lines.append(f'{self.name}_bucket{{le="+Inf"}} {acc}')
+        lines.append(f"{self.name}_sum {self.sum}")
+        lines.append(f"{self.name}_count {self.total}")
+        return "\n".join(lines)
+
+
+class _Timer:
+    def __init__(self, h: Histogram):
+        self.h = h
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.h.observe(time.perf_counter() - self.t0)
+        return False
+
+
+_REGISTRY: Dict[str, _Metric] = {}
+_reg_lock = threading.Lock()
+
+
+def _get_or_create(cls, name: str, help_: str, **kw):
+    with _reg_lock:
+        m = _REGISTRY.get(name)
+        if m is None:
+            m = cls(name, help_, **kw)
+            _REGISTRY[name] = m
+        return m
+
+
+def counter(name: str, help_: str = "") -> Counter:
+    return _get_or_create(Counter, name, help_)
+
+
+def gauge(name: str, help_: str = "") -> Gauge:
+    return _get_or_create(Gauge, name, help_)
+
+
+def histogram(name: str, help_: str = "", buckets=None) -> Histogram:
+    return _get_or_create(Histogram, name, help_, buckets=buckets)
+
+
+def gather() -> str:
+    """Prometheus text exposition of every registered metric."""
+    out = []
+    with _reg_lock:
+        metrics = list(_REGISTRY.values())
+    for m in sorted(metrics, key=lambda m: m.name):
+        out.append(f"# HELP {m.name} {m.help}")
+        out.append(f"# TYPE {m.name} {m.kind}")
+        out.append(m.expose())
+    return "\n".join(out) + "\n"

@@ -1,0 +1,59 @@
+"""Slot clocks (reference: ``common/slot_clock`` — trait at
+``src/lib.rs:20``, ``SystemTimeSlotClock``, ``ManualSlotClock`` for
+tests)."""
+
+from __future__ import annotations
+
+import time
+
+
+class SlotClock:
+    def __init__(self, genesis_time: int, seconds_per_slot: int):
+        self.genesis_time = genesis_time
+        self.seconds_per_slot = seconds_per_slot
+
+    def now(self) -> int:
+        """Current slot (0 before genesis)."""
+        t = self._unix_time()
+        if t < self.genesis_time:
+            return 0
+        return int(t - self.genesis_time) // self.seconds_per_slot
+
+    def seconds_into_slot(self) -> float:
+        t = self._unix_time()
+        if t < self.genesis_time:
+            return 0.0
+        return (t - self.genesis_time) % self.seconds_per_slot
+
+    def start_of(self, slot: int) -> float:
+        return self.genesis_time + slot * self.seconds_per_slot
+
+    def duration_to_next_slot(self) -> float:
+        return self.start_of(self.now() + 1) - self._unix_time()
+
+    def _unix_time(self) -> float:
+        return time.time()
+
+
+class SystemTimeSlotClock(SlotClock):
+    pass
+
+
+class ManualSlotClock(SlotClock):
+    """Test clock: advanced explicitly (reference ManualSlotClock)."""
+
+    def __init__(self, genesis_time: int = 0, seconds_per_slot: int = 12):
+        super().__init__(genesis_time, seconds_per_slot)
+        self._now = float(genesis_time)
+
+    def set_slot(self, slot: int) -> None:
+        self._now = self.start_of(slot)
+
+    def advance_slots(self, n: int = 1) -> None:
+        self._now += n * self.seconds_per_slot
+
+    def advance_seconds(self, s: float) -> None:
+        self._now += s
+
+    def _unix_time(self) -> float:
+        return self._now

@@ -1,0 +1,144 @@
+"""BeaconProcessor: coalescing, priorities, shedding, delayed requeue.
+
+Reference analogue: ``network/src/beacon_processor/tests.rs`` (876 LoC)
+— batch assembly and queue behaviour over a harness chain.
+"""
+
+import threading
+import time
+
+from lighthouse_tpu.beacon_processor import BeaconProcessor, Work, WorkKind
+
+
+def _collect(results, lock):
+    def cb(r):
+        with lock:
+            results.append(r)
+    return cb
+
+
+def test_batches_coalesce_under_load():
+    """A busy pool accumulates attestations into one batched call."""
+    seen_batches = []
+    release = threading.Event()
+
+    def att_handler(items):
+        if not seen_batches:
+            release.wait(timeout=5)  # first batch blocks the only worker
+        seen_batches.append(len(items))
+        return [None] * len(items)
+
+    bp = BeaconProcessor(
+        {WorkKind.GOSSIP_ATTESTATION: att_handler}, n_workers=1,
+        batch_ceilings={WorkKind.GOSSIP_ATTESTATION: 64},
+    )
+    try:
+        bp.submit(Work(WorkKind.GOSSIP_ATTESTATION, 0))
+        time.sleep(0.15)  # worker picks up item 0 and blocks
+        for i in range(1, 101):
+            assert bp.submit(Work(WorkKind.GOSSIP_ATTESTATION, i))
+        release.set()
+        deadline = time.time() + 5
+        while sum(seen_batches) < 101 and time.time() < deadline:
+            time.sleep(0.01)
+        assert sum(seen_batches) == 101
+        # everything after the blocker coalesced into ceiling-bound batches
+        assert max(seen_batches) > 1
+        assert max(seen_batches) <= 64
+    finally:
+        bp.shutdown()
+
+
+def test_priority_blocks_before_attestations():
+    order = []
+    release = threading.Event()
+    lock = threading.Lock()
+
+    def block_handler(item):
+        with lock:
+            order.append(("block", item))
+
+    def att_handler(items):
+        if not order:
+            release.wait(timeout=5)
+        with lock:
+            order.extend(("att", i) for i in items)
+        return [None] * len(items)
+
+    bp = BeaconProcessor(
+        {WorkKind.GOSSIP_BLOCK: block_handler, WorkKind.GOSSIP_ATTESTATION: att_handler},
+        n_workers=1,
+    )
+    try:
+        # jam the worker with an attestation, then queue atts + a block
+        bp.submit(Work(WorkKind.GOSSIP_ATTESTATION, "jam"))
+        time.sleep(0.15)
+        bp.submit(Work(WorkKind.GOSSIP_ATTESTATION, "a1"))
+        bp.submit(Work(WorkKind.GOSSIP_BLOCK, "b1"))
+        release.set()
+        deadline = time.time() + 5
+        while len(order) < 3 and time.time() < deadline:
+            time.sleep(0.01)
+        # the block must be drained before the queued attestation
+        kinds = [k for k, _ in order if _ != "jam"]
+        assert kinds.index("block") < kinds.index("att")
+    finally:
+        bp.shutdown()
+
+
+def test_full_queue_sheds():
+    ev = threading.Event()
+
+    def handler(items):
+        ev.wait(timeout=5)
+        return [None] * len(items)
+
+    bp = BeaconProcessor(
+        {WorkKind.GOSSIP_ATTESTATION: handler}, n_workers=1,
+        queue_bounds={**{k: 4 for k in WorkKind}},
+    )
+    try:
+        bp.submit(Work(WorkKind.GOSSIP_ATTESTATION, "jam"))
+        time.sleep(0.15)
+        oks = [bp.submit(Work(WorkKind.GOSSIP_ATTESTATION, i)) for i in range(8)]
+        assert oks.count(True) == 4 and oks.count(False) == 4
+        ev.set()
+    finally:
+        bp.shutdown()
+
+
+def test_delayed_requeue():
+    got = []
+    lock = threading.Lock()
+
+    bp = BeaconProcessor(
+        {WorkKind.GOSSIP_BLOCK: lambda item: got.append(item)}, n_workers=1
+    )
+    try:
+        bp.submit_later(Work(WorkKind.GOSSIP_BLOCK, "later"), delay_s=0.2)
+        time.sleep(0.1)
+        assert not got
+        deadline = time.time() + 5
+        while not got and time.time() < deadline:
+            time.sleep(0.02)
+        assert got == ["later"]
+    finally:
+        bp.shutdown()
+
+
+def test_results_delivered_and_latency_recorded():
+    results = []
+    lock = threading.Lock()
+    bp = BeaconProcessor(
+        {WorkKind.GOSSIP_ATTESTATION: lambda items: [i * 2 for i in items]},
+        n_workers=2,
+    )
+    try:
+        for i in range(10):
+            bp.submit(Work(WorkKind.GOSSIP_ATTESTATION, i, done=_collect(results, lock)))
+        deadline = time.time() + 5
+        while len(results) < 10 and time.time() < deadline:
+            time.sleep(0.01)
+        assert sorted(results) == [i * 2 for i in range(10)]
+    finally:
+        bp.shutdown()

@@ -1,0 +1,156 @@
+"""Key management: EIP-2333 spec vectors, keystore round-trips, wallet
+derivation, EIP-3076 slashing protection semantics + interchange."""
+
+import json
+
+import pytest
+
+from lighthouse_tpu.keys import (
+    SlashingDatabase,
+    SlashingProtectionError,
+    Wallet,
+    decrypt,
+    derive_child_sk,
+    derive_master_sk,
+    derive_sk_at_path,
+    encrypt,
+)
+from lighthouse_tpu.keys.keystore import KeystoreError, normalize_password
+
+
+# -- EIP-2333 published test case 0 ----------------------------------------
+
+EIP2333_SEED = bytes.fromhex(
+    "c55257c360c07c72029aebc1b53c05ed0362ada38ead3e3e9efa3708e53495531f09a6"
+    "987599d18264c1e1c92f2cf141630c7a3c4ab7c81b2f001698e7463b04"
+)
+EIP2333_MASTER_SK = (
+    6083874454709270928345386274498605044986640685124978867557563392430687146096
+)
+EIP2333_CHILD_INDEX = 0
+EIP2333_CHILD_SK = (
+    20397789859736650942317412262472558107875392172444076792671091975210932703118
+)
+
+
+def test_eip2333_vector_0():
+    master = derive_master_sk(EIP2333_SEED)
+    assert master == EIP2333_MASTER_SK
+    child = derive_child_sk(master, EIP2333_CHILD_INDEX)
+    assert child == EIP2333_CHILD_SK
+
+
+def test_derive_path_and_determinism():
+    sk1 = derive_sk_at_path(EIP2333_SEED, "m/12381/3600/0/0/0")
+    sk2 = derive_sk_at_path(EIP2333_SEED, "m/12381/3600/0/0/0")
+    sk3 = derive_sk_at_path(EIP2333_SEED, "m/12381/3600/1/0/0")
+    assert sk1 == sk2 != sk3
+    with pytest.raises(ValueError):
+        derive_sk_at_path(EIP2333_SEED, "x/12381")
+    with pytest.raises(ValueError):
+        derive_master_sk(b"short")
+
+
+# -- EIP-2335 keystores ----------------------------------------------------
+
+@pytest.mark.parametrize("kdf", ["scrypt", "pbkdf2"])
+def test_keystore_roundtrip(kdf):
+    secret = bytes(range(32))
+    store = encrypt(secret, "correct horse", kdf=kdf, kdf_work=1024, path="m/12381/3600/0/0/0")
+    # JSON-serializable and versioned
+    parsed = json.loads(json.dumps(store))
+    assert parsed["version"] == 4
+    assert decrypt(parsed, "correct horse") == secret
+    with pytest.raises(KeystoreError):
+        decrypt(parsed, "wrong password")
+
+
+def test_password_normalization():
+    # NFKD normalization + control stripping per EIP-2335
+    assert normalize_password("test\x7fpassword\x00") == b"testpassword"
+    assert normalize_password("Ångström") == normalize_password(
+        "Ångström"
+    )
+
+
+# -- EIP-2386 wallet -------------------------------------------------------
+
+def test_wallet_next_validator():
+    w = Wallet.create("w1", "wallet-pass", seed=EIP2333_SEED, kdf_work=1024)
+    assert w.nextaccount == 0
+    signing, withdrawal = w.next_validator("wallet-pass", "ks-pass", kdf_work=1024)
+    assert w.nextaccount == 1
+    assert signing["path"] == "m/12381/3600/0/0/0"
+    assert withdrawal["path"] == "m/12381/3600/0/0"
+    sk_bytes = decrypt(signing, "ks-pass")
+    want = derive_sk_at_path(EIP2333_SEED, "m/12381/3600/0/0/0")
+    assert int.from_bytes(sk_bytes, "big") == want
+    # pubkey in keystore matches the derived key
+    from lighthouse_tpu.crypto import bls
+
+    assert signing["pubkey"] == bls.SecretKey(want).public_key().serialize().hex()
+
+
+# -- EIP-3076 slashing protection ------------------------------------------
+
+PK = b"\xaa" * 48
+
+
+@pytest.fixture
+def db():
+    d = SlashingDatabase(genesis_validators_root=b"\x11" * 32)
+    d.register_validator(PK)
+    return d
+
+
+def test_block_rules(db):
+    db.check_and_insert_block_proposal(PK, 10, b"\x01" * 32)
+    # idempotent same-root re-sign
+    db.check_and_insert_block_proposal(PK, 10, b"\x01" * 32)
+    with pytest.raises(SlashingProtectionError):
+        db.check_and_insert_block_proposal(PK, 10, b"\x02" * 32)
+    with pytest.raises(SlashingProtectionError):
+        db.check_and_insert_block_proposal(PK, 5, b"\x03" * 32)
+    db.check_and_insert_block_proposal(PK, 11, b"\x04" * 32)
+
+
+def test_attestation_rules(db):
+    db.check_and_insert_attestation(PK, 2, 3, b"\x01" * 32)
+    # double vote
+    with pytest.raises(SlashingProtectionError):
+        db.check_and_insert_attestation(PK, 2, 3, b"\x02" * 32)
+    # surround an existing vote (1 < 2, 4 > 3)
+    with pytest.raises(SlashingProtectionError):
+        db.check_and_insert_attestation(PK, 1, 4, b"\x03" * 32)
+    db.check_and_insert_attestation(PK, 3, 5, b"\x04" * 32)
+    # surrounded by existing (3,5): new (4, ...<5)
+    with pytest.raises(SlashingProtectionError):
+        db.check_and_insert_attestation(PK, 4, 4, b"\x05" * 32)
+    # source > target is absurd
+    with pytest.raises(SlashingProtectionError):
+        db.check_and_insert_attestation(PK, 9, 8, b"\x06" * 32)
+
+
+def test_interchange_roundtrip(db):
+    db.check_and_insert_block_proposal(PK, 7, b"\x01" * 32)
+    db.check_and_insert_attestation(PK, 0, 1, b"\x02" * 32)
+    blob = db.export_json()
+    obj = json.loads(blob)
+    assert obj["metadata"]["interchange_format_version"] == "5"
+
+    db2 = SlashingDatabase(genesis_validators_root=b"\x11" * 32)
+    db2.import_json(blob)
+    # imported history enforces the same protections
+    with pytest.raises(SlashingProtectionError):
+        db2.check_and_insert_block_proposal(PK, 7, b"\x99" * 32)
+    with pytest.raises(SlashingProtectionError):
+        db2.check_and_insert_attestation(PK, 0, 1, b"\x99" * 32)
+    # and permits fresh ones
+    db2.check_and_insert_attestation(PK, 1, 2, b"\x03" * 32)
+
+
+def test_interchange_rejects_wrong_genesis(db):
+    blob = db.export_json()
+    db3 = SlashingDatabase(genesis_validators_root=b"\x22" * 32)
+    with pytest.raises(SlashingProtectionError):
+        db3.import_json(blob)

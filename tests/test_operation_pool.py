@@ -1,0 +1,148 @@
+"""Operation pool: max-cover scenarios (mirroring
+``operation_pool/src/max_cover.rs`` unit tests), on-insert aggregation,
+and block packing that survives the state transition."""
+
+import copy
+
+import pytest
+
+from lighthouse_tpu.crypto import backend
+from lighthouse_tpu.operation_pool import OperationPool, maximum_cover
+from lighthouse_tpu.testing.harness import StateHarness
+from lighthouse_tpu.types.chain_spec import minimal_spec
+from lighthouse_tpu.types.preset import MINIMAL
+
+
+# -- max_cover unit scenarios (reference max_cover.rs tests) ---------------
+
+def test_max_cover_empty():
+    assert maximum_cover([], 5) == []
+
+
+def test_max_cover_singleton():
+    picked = maximum_cover([("a", {1: 10})], 5)
+    assert [i for i, _ in picked] == ["a"]
+
+
+def test_max_cover_greedy_prefers_biggest_then_disjoint():
+    items = [
+        ("big", {1: 1, 2: 1, 3: 1}),
+        ("mid", {3: 1, 4: 1}),
+        ("small", {4: 1}),
+    ]
+    picked = maximum_cover(items, 2)
+    assert [i for i, _ in picked] == ["big", "mid"]
+    # "mid"'s credited coverage excludes the already-covered key 3
+    assert picked[1][1] == {4: 1}
+
+
+def test_max_cover_skips_fully_covered():
+    items = [
+        ("all", {1: 5, 2: 5}),
+        ("sub", {1: 5}),
+        ("other", {9: 1}),
+    ]
+    picked = maximum_cover(items, 3)
+    names = [i for i, _ in picked]
+    assert names[0] == "all"
+    assert "sub" not in names  # zero marginal value
+    assert "other" in names
+
+
+def test_max_cover_weighted():
+    items = [
+        ("heavy_one", {1: 100}),
+        ("light_three", {2: 1, 3: 1, 4: 1}),
+    ]
+    picked = maximum_cover(items, 1)
+    assert [i for i, _ in picked] == ["heavy_one"]
+
+
+# -- pool behaviour over a real chain --------------------------------------
+
+@pytest.fixture(autouse=True)
+def fake_backend():
+    backend.set_backend("fake")
+    yield
+    backend.set_backend("cpu")
+
+
+@pytest.fixture()
+def harness():
+    return StateHarness(
+        MINIMAL, minimal_spec(), validator_count=8, fork_name="phase0",
+        fake_sign=True,
+    )
+
+
+def _single_bit(att, i):
+    out = copy.deepcopy(att)
+    n = len(att.aggregation_bits)
+    out.aggregation_bits = [j == i for j in range(n)]
+    return out
+
+
+def test_on_insert_aggregation(harness):
+    h = harness
+    h.extend_chain(2, strategy="none", attest=False)
+    pool = OperationPool(h.preset, h.spec, h.t)
+    full = h.attestations_for_slot(h.state, h.state.slot - 1)[0]
+    n = len(full.aggregation_bits)
+    for i in range(n):
+        pool.insert_attestation(_single_bit(full, i))
+    # all singles aggregated into one (disjoint) group
+    assert pool.n_attestations() == 1
+    # duplicate insert is a no-op
+    pool.insert_attestation(_single_bit(full, 0))
+    assert pool.n_attestations() <= 2
+
+
+def test_packing_produces_valid_block(harness):
+    h = harness
+    h.extend_chain(2, strategy="none", attest=False)
+    pool = OperationPool(h.preset, h.spec, h.t)
+    for att in h.attestations_for_slot(h.state, h.state.slot - 1):
+        pool.insert_attestation(att)
+    atts = pool.attestations_for_block(
+        _advanced(h, h.state.slot + 1)
+    )
+    assert atts, "pool must select attestations for the next block"
+    sb = h.produce_block(h.state.slot + 1, attestations=atts)
+    h.process_block(sb, strategy="none")  # raises on invalid packing
+    assert list(h.state.previous_epoch_attestations) or list(
+        h.state.current_epoch_attestations
+    )
+
+
+def _advanced(h, slot):
+    from lighthouse_tpu.state_transition import partial_state_advance
+
+    st = copy.deepcopy(h.state)
+    return partial_state_advance(h.preset, h.spec, st, slot)
+
+
+def test_prune_drops_stale(harness):
+    h = harness
+    h.extend_chain(2, strategy="none", attest=False)
+    pool = OperationPool(h.preset, h.spec, h.t)
+    for att in h.attestations_for_slot(h.state, h.state.slot - 1):
+        pool.insert_attestation(att)
+    assert pool.n_attestations() > 0
+    # advance several epochs; pruning against the new state clears all
+    h.advance_slots(3 * h.preset.SLOTS_PER_EPOCH)
+    pool.prune(h.state)
+    assert pool.n_attestations() == 0
+
+
+def test_exit_packing_respects_limits_and_dedup(harness):
+    h = harness
+    pool = OperationPool(h.preset, h.spec, h.t)
+    t = h.t
+    ex = t.SignedVoluntaryExit(
+        message=t.VoluntaryExit(epoch=0, validator_index=3),
+        signature=b"\x00" * 96,
+    )
+    pool.insert_voluntary_exit(ex)
+    pool.insert_voluntary_exit(ex)  # dedup by validator
+    packing = pool.packing_for_block(None, h.state)
+    assert len(packing["voluntary_exits"]) == 1
