@@ -92,7 +92,19 @@ class ShufflingCache:
         if hit is not None:
             self._map.move_to_end(key)
             return hit
-        cache = CommitteeCache(chain.preset, chain.head_state, epoch)
+        # The shuffling must come from a state on the TARGET's chain — the
+        # head may be on a competing fork with a different RANDAO seed.
+        # Advance the target block's post-state to the epoch if needed.
+        try:
+            state = chain.state_at_block_root(bytes(target_root))
+        except Exception:
+            state = chain.head_state  # pre-genesis targets / missing state
+        target_epoch_slot = epoch * chain.preset.SLOTS_PER_EPOCH
+        if state.slot < target_epoch_slot:
+            state = partial_state_advance(
+                chain.preset, chain.spec, copy.deepcopy(state), target_epoch_slot
+            )
+        cache = CommitteeCache(chain.preset, state, epoch)
         self._map[key] = cache
         while len(self._map) > self.cap:
             self._map.popitem(last=False)
@@ -236,13 +248,51 @@ class BeaconChain:
         return sv.block_root
 
     def process_chain_segment(self, blocks) -> list[bytes]:
-        """Sync-time import: signature-verify the whole segment as one
-        batch before replaying (reference ``process_chain_segment``
-        ``beacon_chain.rs:2340`` + ``signature_verify_chain_segment``)."""
-        roots = []
-        for sb in blocks:  # verified per block but imported without gossip checks
-            roots.append(self.process_block(sb))
-        return roots
+        """Sync-time import: EVERY signature of the whole segment verified
+        in ONE backend batch before any block is imported (reference
+        ``process_chain_segment`` ``beacon_chain.rs:2340`` +
+        ``signature_verify_chain_segment`` ``block_verification.rs:525``
+        — the widest batch the device sees)."""
+        blocks = list(blocks)
+        if not blocks:
+            return []
+        verified = self.signature_verify_chain_segment(blocks)
+        return [self._import_block(sv, ExecutionStatus.IRRELEVANT) for sv in verified]
+
+    def signature_verify_chain_segment(self, blocks) -> list[SignatureVerifiedBlock]:
+        """Accumulate signature sets across all blocks of a contiguous
+        segment, verify once, and return per-block SignatureVerifiedBlock
+        evidence (with each block's advanced pre-state)."""
+        from ..crypto import bls
+        from ..ssz import hash_tree_root as htr
+        from ..state_transition import BlockSignatureAccumulator
+
+        parent_root = bytes(blocks[0].message.parent_root)
+        state = copy.deepcopy(self.state_at_block_root(parent_root))
+        all_sets = []
+        out = []
+        for sb in blocks:
+            state = partial_state_advance(
+                self.preset, self.spec, state, sb.message.slot
+            )
+            block_root = htr(sb.message)
+            acc = BlockSignatureAccumulator(
+                self.preset, self.spec, state, self.pubkey_cache.resolver(),
+                resolver_by_pubkey_bytes=self.pubkey_resolver_by_bytes(),
+            )
+            acc.include_all(sb, block_root=block_root)
+            all_sets.extend(acc.sets)
+            out.append(
+                SignatureVerifiedBlock(sb, block_root, copy.deepcopy(state))
+            )
+            # apply so the next block's sets build on the right state
+            st_process_block(
+                self.preset, self.spec, state, sb, fork_of(state),
+                signature_strategy="none",
+            )
+        if not bls.verify_signature_sets(all_sets):
+            raise BlockError("InvalidSignature", "chain segment batch")
+        return out
 
     # -- attestation pipeline ---------------------------------------------
 
@@ -346,13 +396,24 @@ class BeaconChain:
         ``produce_unaggregated_attestation`` ``beacon_chain.rs:1496``)."""
         t = self.types
         state = self.head_state
+        # An epoch boundary between the head and the duty slot changes the
+        # justified checkpoint — advance a copy so the FFG source matches
+        # what every other node's advanced state expects (the reference
+        # pre-advances via state_advance_timer).
+        if (
+            compute_epoch_at_slot(self.preset, state.slot)
+            < compute_epoch_at_slot(self.preset, slot)
+        ):
+            state = partial_state_advance(
+                self.preset, self.spec, copy.deepcopy(state), slot
+            )
         epoch = compute_epoch_at_slot(self.preset, slot)
         target_slot = epoch * self.preset.SLOTS_PER_EPOCH
-        if state.slot >= target_slot:
+        if state.slot > target_slot:
             hist = state.block_roots[
                 target_slot % self.preset.SLOTS_PER_HISTORICAL_ROOT
             ]
-            target_root = self.head_block_root if state.slot == target_slot else bytes(hist)
+            target_root = bytes(hist)
         else:
             target_root = self.head_block_root
         return t.AttestationData(
@@ -367,8 +428,6 @@ class BeaconChain:
 def _anchor_block_root(state) -> bytes:
     """Root of the anchor (genesis) block implied by a state whose
     latest_block_header.state_root may be unfilled."""
-    header = state.latest_block_header
-    if bytes(header.state_root) == bytes(32):
-        header = copy.copy(header)
-        header.state_root = hash_tree_root(state)
-    return hash_tree_root(header)
+    from ..state_transition.helpers import latest_block_header_root
+
+    return latest_block_header_root(state)

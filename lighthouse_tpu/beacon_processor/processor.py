@@ -85,7 +85,6 @@ class BeaconProcessor:
         self._lock = threading.Lock()
         self._work_ready = threading.Condition(self._lock)
         self._shutdown = False
-        self._idle_workers = 0
         self._delayed: list[tuple[float, Work]] = []
         self._workers = [
             threading.Thread(target=self._worker_loop, name=f"bp-worker-{i}", daemon=True)
@@ -140,12 +139,10 @@ class BeaconProcessor:
     def _worker_loop(self) -> None:
         while True:
             with self._lock:
-                self._idle_workers += 1
                 got = self._next_batch()
                 while not self._shutdown and got is None:
                     self._work_ready.wait(timeout=0.1)
                     got = self._next_batch()
-                self._idle_workers -= 1
                 if got is None:  # shutdown with empty queues
                     return
                 kind, batch = got

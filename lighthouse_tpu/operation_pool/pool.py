@@ -190,7 +190,6 @@ class OperationPool:
             )
         ][: P.MAX_PROPOSER_SLASHINGS]
 
-        covered: set[int] = set()
         att_candidates = [
             (s, self._slashable_indices(s, state)) for s in attester_slashings
         ]

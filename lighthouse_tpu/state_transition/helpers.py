@@ -263,3 +263,22 @@ def is_valid_indexed_attestation_structure(preset: Preset, indexed) -> bool:
     backend via signature_sets)."""
     idx = indexed.attesting_indices
     return bool(idx) and list(idx) == sorted(set(idx))
+
+
+def latest_block_header_root(state, state_root_hint: bytes | None = None) -> bytes:
+    """Block root implied by ``state.latest_block_header``. The in-flight
+    header's state_root is zero until the next process_slot fills it;
+    hashing it raw would give a root no other node computes, so fill it
+    (with ``state_root_hint`` when the caller already knows the state
+    root, else by hashing the state)."""
+    import copy as _copy
+
+    from ..ssz import hash_tree_root
+
+    header = state.latest_block_header
+    if bytes(header.state_root) == bytes(32):
+        header = _copy.copy(header)
+        header.state_root = (
+            state_root_hint if state_root_hint is not None else hash_tree_root(state)
+        )
+    return hash_tree_root(header)

@@ -322,18 +322,9 @@ class HotColdDB:
 
 
 def _latest_block_root(state, state_root_hint: bytes | None = None) -> bytes:
-    """Root of the latest block header, with the state-root field filled
-    (spec get_block_root semantics for the in-flight header)."""
-    header = state.latest_block_header
-    if bytes(header.state_root) != bytes(32):
-        return hash_tree_root(header)
-    import copy
+    from ..state_transition.helpers import latest_block_header_root
 
-    h = copy.copy(header)
-    # The in-flight header's state_root is zero until the next process_slot
-    # fills it; callers passing the current state's root reproduce that.
-    h.state_root = state_root_hint if state_root_hint is not None else bytes(32)
-    return hash_tree_root(h)
+    return latest_block_header_root(state, state_root_hint)
 
 
 def _fork_of_block(types, signed_block) -> str:

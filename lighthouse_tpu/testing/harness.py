@@ -95,16 +95,9 @@ class StateHarness:
     # -- attestations ----------------------------------------------------
 
     def _head_block_root(self, state) -> bytes:
-        """Block root of the state's latest header. The in-flight header's
-        state_root is zero until the next process_slot — hashing it raw
-        would give a root no other node computes, so fill it first."""
-        header = state.latest_block_header
-        if bytes(header.state_root) == bytes(32):
-            import copy as _copy
+        from ..state_transition.helpers import latest_block_header_root
 
-            header = _copy.copy(header)
-            header.state_root = hash_tree_root(state)
-        return hash_tree_root(header)
+        return latest_block_header_root(state)
 
     def attestations_for_slot(self, state, slot: int):
         """Fully-participating attestations for every committee at ``slot``

@@ -1,0 +1,154 @@
+"""ef_tests: the eight BLS handlers, run against BOTH the cpu and tpu
+backends (reference: ``testing/ef_tests/src/cases/bls_*.rs`` registered in
+``tests/tests.rs:105-148``; the reference runs its suite once per backend
+feature, ``Makefile:109-113``)."""
+
+import pytest
+
+from ef_loader import cases, hex_to_bytes, load_yaml, require_vectors
+
+from lighthouse_tpu.crypto import backend, bls
+
+
+def _cases(handler):
+    require_vectors()
+    out = list(cases("general", "phase0", "bls", handler))
+    if not out:
+        pytest.skip(f"no vectors for bls/{handler}")
+    return out
+
+
+@pytest.fixture(params=["cpu", "tpu"])
+def bls_backend(request):
+    backend.set_backend(request.param)
+    yield request.param
+    backend.set_backend("cpu")
+
+
+def _sig(data: str):
+    try:
+        return bls.Signature.deserialize(hex_to_bytes(data))
+    except bls.BlsError:
+        return None
+
+
+def _pk(data: str):
+    try:
+        return bls.PublicKey.deserialize(hex_to_bytes(data))
+    except bls.BlsError:
+        return None
+
+
+def test_sign(bls_backend):
+    for case in _cases("sign"):
+        d = load_yaml(case / "data.yaml")
+        privkey = int(d["input"]["privkey"], 16)
+        message = hex_to_bytes(d["input"]["message"])
+        if privkey == 0:
+            assert d["output"] is None
+            continue
+        sig = bls.SecretKey(privkey).sign(message)
+        assert sig.serialize() == hex_to_bytes(d["output"]), case.name
+
+
+def test_verify(bls_backend):
+    for case in _cases("verify"):
+        d = load_yaml(case / "data.yaml")
+        pk = _pk(d["input"]["pubkey"])
+        sig = _sig(d["input"]["signature"])
+        message = hex_to_bytes(d["input"]["message"])
+        if pk is None or sig is None:
+            assert d["output"] is False, case.name
+            continue
+        assert sig.verify(pk, message) == d["output"], case.name
+
+
+def test_aggregate(bls_backend):
+    for case in _cases("aggregate"):
+        d = load_yaml(case / "data.yaml")
+        sigs = [_sig(s) for s in d["input"]]
+        if not sigs or any(s is None for s in sigs):
+            assert d["output"] is None, case.name
+            continue
+        agg = bls.AggregateSignature.infinity()
+        for s in sigs:
+            agg.add_assign(s)
+        assert agg.serialize() == hex_to_bytes(d["output"]), case.name
+
+
+def test_aggregate_verify(bls_backend):
+    for case in _cases("aggregate_verify"):
+        d = load_yaml(case / "data.yaml")
+        pks = [_pk(p) for p in d["input"]["pubkeys"]]
+        msgs = [hex_to_bytes(m) for m in d["input"]["messages"]]
+        sig = _sig(d["input"]["signature"])
+        if sig is None or any(p is None for p in pks):
+            assert d["output"] is False, case.name
+            continue
+        agg = bls.AggregateSignature(sig.point, sig.serialize())
+        assert agg.aggregate_verify(msgs, pks) == d["output"], case.name
+
+
+def test_fast_aggregate_verify(bls_backend):
+    for case in _cases("fast_aggregate_verify"):
+        d = load_yaml(case / "data.yaml")
+        pks = [_pk(p) for p in d["input"]["pubkeys"]]
+        msg = hex_to_bytes(d["input"]["message"])
+        sig = _sig(d["input"]["signature"])
+        if sig is None or any(p is None for p in pks):
+            assert d["output"] is False, case.name
+            continue
+        agg = bls.AggregateSignature(sig.point, sig.serialize())
+        assert agg.fast_aggregate_verify(msg, pks) == d["output"], case.name
+
+
+def test_eth_fast_aggregate_verify(bls_backend):
+    """Spec eth2 variant: infinity signature + no pubkeys is VALID."""
+    for case in _cases("eth_fast_aggregate_verify"):
+        d = load_yaml(case / "data.yaml")
+        pks = [_pk(p) for p in d["input"]["pubkeys"]]
+        msg = hex_to_bytes(d["input"]["message"])
+        raw_sig = hex_to_bytes(d["input"]["signature"])
+        if not pks and raw_sig == bls.INFINITY_SIGNATURE:
+            assert d["output"] is True, case.name
+            continue
+        sig = _sig(d["input"]["signature"])
+        if sig is None or any(p is None for p in pks):
+            assert d["output"] is False, case.name
+            continue
+        agg = bls.AggregateSignature(sig.point, sig.serialize())
+        assert agg.fast_aggregate_verify(msg, pks) == d["output"], case.name
+
+
+def test_eth_aggregate_pubkeys(bls_backend):
+    for case in _cases("eth_aggregate_pubkeys"):
+        d = load_yaml(case / "data.yaml")
+        pks = [_pk(p) for p in d["input"]]
+        if not pks or any(p is None for p in pks):
+            assert d["output"] is None, case.name
+            continue
+        acc = pks[0].point
+        for p in pks[1:]:
+            acc = acc + p.point
+        if acc.is_infinity():
+            assert d["output"] is None, case.name
+            continue
+        assert acc.compress() == hex_to_bytes(d["output"]), case.name
+
+
+def test_batch_verify(bls_backend):
+    """THE north-star handler (reference
+    ``cases/bls_batch_verify.rs:25-67``)."""
+    for case in _cases("batch_verify"):
+        d = load_yaml(case / "data.yaml")
+        pks = [_pk(p) for p in d["input"]["pubkeys"]]
+        msgs = [hex_to_bytes(m) for m in d["input"]["messages"]]
+        sigs = [_sig(s) for s in d["input"]["signatures"]]
+        if any(x is None for x in pks) or any(s is None for s in sigs):
+            assert d["output"] is False, case.name
+            continue
+        sets = [
+            bls.SignatureSet.single_pubkey(s, p, m)
+            for s, p, m in zip(sigs, pks, msgs)
+        ]
+        assert bls.verify_signature_sets(sets) == d["output"], case.name

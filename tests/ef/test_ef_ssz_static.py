@@ -1,0 +1,69 @@
+"""ef_tests: ssz_static — decode serialized.ssz_snappy, re-encode
+bit-exactly, match roots.yaml (reference ``cases/ssz_static.rs``)."""
+
+import pytest
+
+from ef_loader import (
+    FORKS,
+    cases,
+    hex_to_bytes,
+    load_ssz_snappy,
+    load_yaml,
+    preset_for,
+    require_vectors,
+)
+
+from lighthouse_tpu.ssz import hash_tree_root
+from lighthouse_tpu.types.containers import types_for
+
+# Container name in the vectors -> attribute on the types namespace (per
+# fork; blocks/states resolve through the fork maps).
+_DIRECT = [
+    "Fork", "ForkData", "Checkpoint", "Validator", "AttestationData",
+    "IndexedAttestation", "PendingAttestation", "Attestation", "Eth1Data",
+    "HistoricalBatch", "DepositMessage", "DepositData", "Deposit",
+    "BeaconBlockHeader", "SignedBeaconBlockHeader", "ProposerSlashing",
+    "AttesterSlashing", "VoluntaryExit", "SignedVoluntaryExit",
+    "SyncAggregate", "SyncCommittee", "AggregateAndProof",
+    "SignedAggregateAndProof", "SyncCommitteeMessage",
+    "SyncCommitteeContribution", "ContributionAndProof",
+    "SignedContributionAndProof", "ExecutionPayload",
+    "ExecutionPayloadHeader", "SigningData",
+]
+
+
+def _resolve(t, name: str, fork: str):
+    if name == "BeaconState":
+        return t.state[fork]
+    if name == "BeaconBlock":
+        return t.block[fork]
+    if name == "SignedBeaconBlock":
+        return t.signed_block[fork]
+    if name == "BeaconBlockBody":
+        return t.block_body[fork]
+    return getattr(t, name, None)
+
+
+@pytest.mark.parametrize("config", ["minimal", "mainnet"])
+@pytest.mark.parametrize("fork", FORKS)
+def test_ssz_static(config, fork):
+    require_vectors()
+    t = types_for(preset_for(config))
+    ran = 0
+    for name in _DIRECT + [
+        "BeaconState", "BeaconBlock", "SignedBeaconBlock", "BeaconBlockBody"
+    ]:
+        tpe = _resolve(t, name, fork)
+        if tpe is None:
+            continue
+        for case in cases(config, fork, "ssz_static", name):
+            serialized = load_ssz_snappy(case / "serialized.ssz_snappy")
+            roots = load_yaml(case / "roots.yaml")
+            value = tpe.decode(serialized)
+            assert tpe.encode(value) == serialized, f"{name}/{case.name}: re-encode"
+            assert hash_tree_root(tpe, value) == hex_to_bytes(roots["root"]), (
+                f"{name}/{case.name}: root"
+            )
+            ran += 1
+    if ran == 0:
+        pytest.skip(f"no ssz_static vectors for {config}/{fork}")

@@ -1,0 +1,270 @@
+"""ef_tests: shuffling, operations, sanity, epoch_processing, fork
+upgrades (reference ``cases/{shuffling,operations,sanity_*,
+epoch_processing,fork}.rs``)."""
+
+import pytest
+
+from ef_loader import (
+    FORKS,
+    cases,
+    hex_to_bytes,
+    load_meta,
+    load_ssz_snappy,
+    load_yaml,
+    maybe,
+    preset_for,
+    require_vectors,
+    spec_for,
+)
+
+from lighthouse_tpu.ssz import hash_tree_root
+from lighthouse_tpu.state_transition import (
+    compute_shuffled_index,
+    per_slot_processing,
+    process_block,
+)
+from lighthouse_tpu.state_transition import block as st_block
+from lighthouse_tpu.state_transition import epoch as st_epoch
+from lighthouse_tpu.state_transition.block import (
+    BlockProcessingError,
+    state_pubkey_resolver,
+)
+from lighthouse_tpu.state_transition.upgrade import (
+    upgrade_to_altair,
+    upgrade_to_bellatrix,
+)
+from lighthouse_tpu.types.containers import types_for
+
+CONFIGS = ["minimal", "mainnet"]
+
+
+def _state(t, fork, case, name):
+    p = maybe(case / f"{name}.ssz_snappy")
+    return t.state[fork].decode(load_ssz_snappy(p)) if p else None
+
+
+@pytest.mark.parametrize("config", CONFIGS)
+def test_shuffling(config):
+    require_vectors()
+    P = preset_for(config)
+    ran = 0
+    for case in cases(config, "phase0", "shuffling", "core"):
+        d = load_yaml(case / "mapping.yaml")
+        seed = hex_to_bytes(d["seed"])
+        count = d["count"]
+        mapping = d["mapping"]
+        got = [
+            compute_shuffled_index(i, count, seed, P.SHUFFLE_ROUND_COUNT)
+            for i in range(count)
+        ]
+        assert got == mapping, case.name
+        ran += 1
+    if ran == 0:
+        pytest.skip("no shuffling vectors")
+
+
+# operation handler -> (input file stem, apply function)
+def _apply_operation(P, spec, state, fork, handler, op, t):
+    resolver = state_pubkey_resolver(state)
+    if handler == "attestation":
+        st_block.process_attestation(P, spec, state, op, fork, True, resolver)
+    elif handler == "attester_slashing":
+        st_block.process_attester_slashing(P, spec, state, op, fork, True, resolver)
+    elif handler == "proposer_slashing":
+        st_block.process_proposer_slashing(P, spec, state, op, fork, True, resolver)
+    elif handler == "block_header":
+        st_block.process_block_header(P, state, op)
+    elif handler == "deposit":
+        st_block.process_deposit(P, spec, state, op, fork)
+    elif handler == "voluntary_exit":
+        st_block.process_voluntary_exit(P, spec, state, op, True, resolver)
+    elif handler == "sync_aggregate":
+        from lighthouse_tpu.state_transition.block import state_pubkey_bytes_resolver
+
+        st_block.process_sync_aggregate(
+            P, spec, state, state.slot, op, True,
+            state_pubkey_bytes_resolver(state),
+        )
+    elif handler == "execution_payload":
+        st_block.process_execution_payload(P, spec, state, op, None)
+    else:
+        pytest.skip(f"operation handler {handler} not mapped")
+
+
+_OP_FILES = {
+    "attestation": ("attestation", "Attestation"),
+    "attester_slashing": ("attester_slashing", "AttesterSlashing"),
+    "block_header": ("block", None),  # BeaconBlock per fork
+    "deposit": ("deposit", "Deposit"),
+    "proposer_slashing": ("proposer_slashing", "ProposerSlashing"),
+    "voluntary_exit": ("voluntary_exit", "SignedVoluntaryExit"),
+    "sync_aggregate": ("sync_aggregate", "SyncAggregate"),
+    "execution_payload": ("execution_payload", "ExecutionPayload"),
+}
+
+
+@pytest.mark.parametrize("config", CONFIGS)
+@pytest.mark.parametrize("fork", FORKS)
+def test_operations(config, fork):
+    require_vectors()
+    P = preset_for(config)
+    spec = spec_for(config)
+    t = types_for(P)
+    ran = 0
+    for handler, (stem, type_name) in _OP_FILES.items():
+        for case in cases(config, fork, "operations", handler):
+            pre = _state(t, fork, case, "pre")
+            if pre is None:
+                continue
+            post = _state(t, fork, case, "post")
+            op_path = maybe(case / f"{stem}.ssz_snappy")
+            if op_path is None:
+                continue
+            tpe = t.block[fork] if type_name is None else getattr(t, type_name)
+            op = tpe.decode(load_ssz_snappy(op_path))
+            if type_name is None:
+                op = op  # block_header takes the full block message
+            try:
+                _apply_operation(P, spec, pre, fork, handler, op, t)
+                ok = True
+            except (BlockProcessingError, ValueError, IndexError):
+                ok = False
+            if post is None:
+                assert not ok, f"{handler}/{case.name}: must be invalid"
+            else:
+                assert ok, f"{handler}/{case.name}: must be valid"
+                assert hash_tree_root(pre) == hash_tree_root(post), (
+                    f"{handler}/{case.name}: post-state mismatch"
+                )
+            ran += 1
+    if ran == 0:
+        pytest.skip(f"no operations vectors for {config}/{fork}")
+
+
+@pytest.mark.parametrize("config", CONFIGS)
+@pytest.mark.parametrize("fork", FORKS)
+def test_sanity_slots(config, fork):
+    require_vectors()
+    P = preset_for(config)
+    spec = spec_for(config)
+    t = types_for(P)
+    ran = 0
+    for case in cases(config, fork, "sanity", "slots"):
+        pre = _state(t, fork, case, "pre")
+        post = _state(t, fork, case, "post")
+        n = load_yaml(case / "slots.yaml")
+        state = pre
+        for _ in range(int(n)):
+            state = per_slot_processing(P, spec, state)
+        assert hash_tree_root(state) == hash_tree_root(post), case.name
+        ran += 1
+    if ran == 0:
+        pytest.skip(f"no sanity/slots vectors for {config}/{fork}")
+
+
+@pytest.mark.parametrize("config", CONFIGS)
+@pytest.mark.parametrize("fork", FORKS)
+def test_sanity_blocks(config, fork):
+    require_vectors()
+    P = preset_for(config)
+    spec = spec_for(config)
+    t = types_for(P)
+    ran = 0
+    for case in cases(config, fork, "sanity", "blocks"):
+        meta = load_meta(case)
+        pre = _state(t, fork, case, "pre")
+        post = _state(t, fork, case, "post")
+        n_blocks = meta.get("blocks_count", 0)
+        verify = meta.get("bls_setting", 1) != 2
+        state = pre
+        ok = True
+        try:
+            for i in range(n_blocks):
+                sb = t.signed_block[fork].decode(
+                    load_ssz_snappy(case / f"blocks_{i}.ssz_snappy")
+                )
+                while state.slot < sb.message.slot:
+                    state = per_slot_processing(P, spec, state)
+                process_block(
+                    P, spec, state, sb, fork,
+                    signature_strategy="individual" if verify else "none",
+                )
+        except (BlockProcessingError, ValueError, IndexError):
+            ok = False
+        if post is None:
+            assert not ok, f"{case.name}: must be invalid"
+        else:
+            assert ok, f"{case.name}: must be valid"
+            assert hash_tree_root(state) == hash_tree_root(post), case.name
+        ran += 1
+    if ran == 0:
+        pytest.skip(f"no sanity/blocks vectors for {config}/{fork}")
+
+
+_EPOCH_FNS = {
+    "justification_and_finalization": lambda P, s, st, fork: (
+        st_epoch.process_justification_and_finalization_phase0(P, st)
+        if fork == "phase0"
+        else st_epoch.process_justification_and_finalization_altair(P, st)
+    ),
+    "inactivity_updates": lambda P, s, st, fork: st_epoch.process_inactivity_updates(P, s, st),
+    "registry_updates": lambda P, s, st, fork: st_epoch.process_registry_updates(P, s, st),
+    "slashings": lambda P, s, st, fork: st_epoch.process_slashings(P, st, fork),
+    "eth1_data_reset": lambda P, s, st, fork: st_epoch.process_eth1_data_reset(P, st),
+    "effective_balance_updates": lambda P, s, st, fork: st_epoch.process_effective_balance_updates(P, st),
+    "slashings_reset": lambda P, s, st, fork: st_epoch.process_slashings_reset(P, st),
+    "randao_mixes_reset": lambda P, s, st, fork: st_epoch.process_randao_mixes_reset(P, st),
+    "historical_roots_update": lambda P, s, st, fork: st_epoch.process_historical_roots_update(P, st),
+    "sync_committee_updates": lambda P, s, st, fork: st_epoch.process_sync_committee_updates(P, st),
+}
+
+
+@pytest.mark.parametrize("config", CONFIGS)
+@pytest.mark.parametrize("fork", FORKS)
+def test_epoch_processing(config, fork):
+    require_vectors()
+    P = preset_for(config)
+    spec = spec_for(config)
+    t = types_for(P)
+    ran = 0
+    for handler, fn in _EPOCH_FNS.items():
+        for case in cases(config, fork, "epoch_processing", handler):
+            pre = _state(t, fork, case, "pre")
+            if pre is None:
+                continue
+            post = _state(t, fork, case, "post")
+            try:
+                fn(P, spec, pre, fork)
+                ok = True
+            except (ValueError, IndexError):
+                ok = False
+            if post is None:
+                assert not ok, f"{handler}/{case.name}"
+            else:
+                assert ok and hash_tree_root(pre) == hash_tree_root(post), (
+                    f"{handler}/{case.name}"
+                )
+            ran += 1
+    if ran == 0:
+        pytest.skip(f"no epoch_processing vectors for {config}/{fork}")
+
+
+@pytest.mark.parametrize("config", CONFIGS)
+@pytest.mark.parametrize(
+    "fork,upgrade", [("altair", upgrade_to_altair), ("bellatrix", upgrade_to_bellatrix)]
+)
+def test_fork_upgrade(config, fork, upgrade):
+    require_vectors()
+    P = preset_for(config)
+    spec = spec_for(config)
+    prev = {"altair": "phase0", "bellatrix": "altair"}[fork]
+    t = types_for(P)
+    ran = 0
+    for case in cases(config, fork, "fork", "fork"):
+        pre = _state(t, prev, case, "pre")
+        post = _state(t, fork, case, "post")
+        got = upgrade(P, spec, pre)
+        assert hash_tree_root(got) == hash_tree_root(post), case.name
+        ran += 1
+    if ran == 0:
+        pytest.skip(f"no fork vectors for {config}/{fork}")
