@@ -153,9 +153,34 @@ class BeaconChain:
         self.head_block_root = genesis_block_root
         self.head_state = genesis_state
         self._last_finalized_epoch = genesis_state.finalized_checkpoint.epoch
+
+        # Materialize the anchor block implied by the state's header (an
+        # interop/spec genesis has an empty body); lets block_id lookups
+        # resolve "head" from slot 0. A checkpoint-sync anchor whose real
+        # body is unknown simply skips this (root would not match).
+        fork = fork_of(genesis_state)
+        header = genesis_state.latest_block_header
+        anchor = types.block[fork](
+            slot=header.slot,
+            proposer_index=header.proposer_index,
+            parent_root=bytes(header.parent_root),
+            state_root=(
+                self.genesis_state_root
+                if bytes(header.state_root) == bytes(32)
+                else bytes(header.state_root)
+            ),
+            body=types.block_body[fork](),
+        )
+        if hash_tree_root(anchor) == genesis_block_root:
+            store.put_block(
+                genesis_block_root, types.signed_block[fork](message=anchor)
+            )
         self.snapshot_cache.insert(genesis_block_root, genesis_state)
         store.put_state_snapshot(self.genesis_state_root, genesis_state)
-        store.put_genesis_state_root(self.genesis_state_root)
+        # The anchor may be a resumed HEAD, not genesis: never clobber an
+        # existing genesis-root record.
+        if store.get_genesis_state_root() is None:
+            store.put_genesis_state_root(self.genesis_state_root)
         store.put_head(genesis_block_root)
 
     # -- clock / lookup ---------------------------------------------------

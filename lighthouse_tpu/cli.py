@@ -1,0 +1,213 @@
+"""CLI / process runtime (reference: the ``lighthouse`` binary —
+``lighthouse/src/main.rs:34,339-343`` dispatching ``bn|vc|am|db``, with
+``lighthouse/environment`` owning runtime + shutdown; the north-star
+``--bls-backend tpu`` flag lands exactly here, per SURVEY.md §2.7/§5).
+
+    python -m lighthouse_tpu bn --preset minimal --interop-validators 64
+    python -m lighthouse_tpu vc --beacon-node http://127.0.0.1:5052 ...
+    python -m lighthouse_tpu am wallet create|validator create ...
+    python -m lighthouse_tpu db inspect --datadir ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import getpass
+import json
+import signal
+import sys
+import threading
+
+
+def _add_global_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument(
+        "--preset", choices=["mainnet", "minimal"], default="mainnet",
+        help="compile-time preset analogue (EthSpec selection)",
+    )
+    p.add_argument(
+        "--bls-backend", choices=["cpu", "fake", "tpu"], default="cpu",
+        help="BLS execution backend (the TPU batch verifier is 'tpu')",
+    )
+    p.add_argument("--datadir", default=None)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    top = argparse.ArgumentParser(prog="lighthouse_tpu")
+    sub = top.add_subparsers(dest="command", required=True)
+
+    bn = sub.add_parser("bn", help="run a beacon node")
+    _add_global_flags(bn)
+    bn.add_argument("--http-port", type=int, default=5052)
+    bn.add_argument("--http-host", default="127.0.0.1")
+    bn.add_argument("--interop-validators", type=int, default=None,
+                    help="quick-start genesis with N deterministic validators")
+    bn.add_argument("--genesis-time", type=int, default=None)
+    bn.add_argument("--workers", type=int, default=2)
+
+    vc = sub.add_parser("vc", help="run a validator client")
+    _add_global_flags(vc)
+    vc.add_argument("--beacon-node", action="append", required=True,
+                    help="beacon node URL (repeatable for fallback)")
+    vc.add_argument("--keystore", action="append", default=[],
+                    help="EIP-2335 keystore path (repeatable)")
+    vc.add_argument("--interop-keys", type=str, default=None,
+                    help="range like 0:8 of deterministic interop keys")
+
+    am = sub.add_parser("am", help="account manager")
+    _add_global_flags(am)
+    am_sub = am.add_subparsers(dest="am_command", required=True)
+    w = am_sub.add_parser("wallet-create")
+    w.add_argument("--name", required=True)
+    w.add_argument("--out", required=True)
+    v = am_sub.add_parser("validator-create")
+    v.add_argument("--wallet", required=True)
+    v.add_argument("--out-dir", required=True)
+    v.add_argument("--count", type=int, default=1)
+
+    db = sub.add_parser("db", help="database manager")
+    _add_global_flags(db)
+    db_sub = db.add_subparsers(dest="db_command", required=True)
+    inspect = db_sub.add_parser("inspect")
+    inspect.add_argument("--datadir", default=None)
+
+    return top
+
+
+def run_bn(args) -> int:
+    from .client import ClientBuilder, ClientConfig
+    from .types.chain_spec import minimal_spec
+    from .utils import metrics
+
+    cfg = ClientConfig(
+        preset_base=args.preset,
+        datadir=args.datadir,
+        http_host=args.http_host,
+        http_port=args.http_port,
+        bls_backend=args.bls_backend,
+        n_workers=args.workers,
+    )
+    spec = minimal_spec() if args.preset == "minimal" else None
+    builder = ClientBuilder(cfg, spec)
+    if args.interop_validators:
+        import time as _time
+
+        builder.with_interop_genesis(
+            args.interop_validators,
+            genesis_time=args.genesis_time or int(_time.time()),
+        )
+    client = builder.build().start()
+    print(
+        f"beacon node up: http://{args.http_host}:{client.api.port} "
+        f"(backend={args.bls_backend}, preset={args.preset})",
+        flush=True,
+    )
+    stop = threading.Event()
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    stop.wait()
+    client.stop()
+    return 0
+
+
+def run_vc(args) -> int:
+    from .eth2_client import BeaconNodeClient
+    from .types.chain_spec import minimal_spec, mainnet_spec
+    from .types.containers import types_for
+    from .types.preset import PRESETS
+    from .utils.slot_clock import SystemTimeSlotClock
+    from .validator_client import BeaconNodeFallback, ValidatorClient, ValidatorStore
+
+    preset = PRESETS[args.preset]
+    spec = minimal_spec() if args.preset == "minimal" else mainnet_spec()
+    t = types_for(preset)
+    clients = [BeaconNodeClient(u, t) for u in args.beacon_node]
+    nodes = BeaconNodeFallback(clients)
+    genesis = nodes.call("genesis")
+    gvr = bytes.fromhex(genesis["genesis_validators_root"][2:])
+    store = ValidatorStore(spec, preset, t, genesis_validators_root=gvr)
+    if args.interop_keys:
+        from .state_transition import interop_secret_key
+
+        lo, hi = (int(x) for x in args.interop_keys.split(":"))
+        for i in range(lo, hi):
+            store.add_secret_key(interop_secret_key(i))
+    for path in args.keystore:
+        with open(path) as f:
+            ks = json.load(f)
+        store.add_keystore(ks, getpass.getpass(f"password for {path}: "))
+    clock = SystemTimeSlotClock(int(genesis["genesis_time"]), spec.seconds_per_slot)
+    vc = ValidatorClient(store, nodes, t, preset, clock)
+    print(f"validator client up: {len(store.pubkeys())} keys", flush=True)
+    signal.signal(signal.SIGINT, lambda *a: vc.stop())
+    signal.signal(signal.SIGTERM, lambda *a: vc.stop())
+    vc.run_forever()
+    return 0
+
+
+def run_am(args) -> int:
+    from .keys import Wallet, save
+
+    if args.am_command == "wallet-create":
+        password = getpass.getpass("wallet password: ")
+        w = Wallet.create(args.name, password)
+        with open(args.out, "w") as f:
+            json.dump(w.json, f, indent=2)
+        print(f"wallet written to {args.out}")
+        return 0
+    if args.am_command == "validator-create":
+        import os
+
+        with open(args.wallet) as f:
+            wobj = json.load(f)
+        w = Wallet(wobj)
+        wallet_pw = getpass.getpass("wallet password: ")
+        ks_pw = getpass.getpass("keystore password: ")
+        os.makedirs(args.out_dir, exist_ok=True)
+        for _ in range(args.count):
+            signing, withdrawal = w.next_validator(wallet_pw, ks_pw)
+            stem = signing["pubkey"][:12]
+            save(signing, f"{args.out_dir}/keystore-{stem}.json")
+            save(withdrawal, f"{args.out_dir}/withdrawal-{stem}.json")
+            print(f"validator 0x{signing['pubkey'][:16]}… written")
+        with open(args.wallet, "w") as f:
+            json.dump(w.json, f, indent=2)
+        return 0
+    return 1
+
+
+def run_db(args) -> int:
+    from .store import Column, SqliteStore
+
+    if args.db_command == "inspect":
+        if not args.datadir:
+            print("--datadir required", file=sys.stderr)
+            return 1
+        kv = SqliteStore(f"{args.datadir}/chain.sqlite")
+        for col, name in [
+            (Column.BLOCK, "blocks"),
+            (Column.STATE, "hot state snapshots"),
+            (Column.STATE_SUMMARY, "hot state summaries"),
+            (Column.COLD_STATE, "cold restore points"),
+        ]:
+            print(f"{name}: {sum(1 for _ in kv.keys(col))}")
+        head = kv.get(Column.METADATA, b"head")
+        print(f"head: {head.hex() if head else None}")
+        return 0
+    return 1
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.command == "bn":
+        return run_bn(args)
+    if args.command == "vc":
+        return run_vc(args)
+    if args.command == "am":
+        return run_am(args)
+    if args.command == "db":
+        return run_db(args)
+    return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

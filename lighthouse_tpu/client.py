@@ -1,0 +1,212 @@
+"""Client builder: assembles a running beacon node (reference:
+``beacon_node/client/src/builder.rs:56-128,676,825`` — store -> chain ->
+network/processor -> HTTP API -> timers; plus ``timer`` and
+``state_advance_timer``).
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .beacon_chain import BeaconChain, VerifiedAggregatedAttestation, VerifiedUnaggregatedAttestation
+from .beacon_processor import BeaconProcessor, Work, WorkKind
+from .http_api import BeaconApiServer
+from .operation_pool import OperationPool
+from .state_transition import store_replayer
+from .store import HotColdDB, MemoryStore, SqliteStore
+from .types.chain_spec import ChainSpec
+from .types.containers import types_for
+from .types.preset import PRESETS
+from .utils.slot_clock import SlotClock, SystemTimeSlotClock
+
+
+@dataclass
+class ClientConfig:
+    preset_base: str = "mainnet"
+    datadir: Optional[str] = None  # None = in-memory store
+    http_host: str = "127.0.0.1"
+    http_port: int = 5052
+    http_enabled: bool = True
+    bls_backend: str = "cpu"  # cpu | fake | tpu — the north-star flag
+    n_workers: int = 2
+    slots_per_snapshot: int = 32
+
+
+class Client:
+    """A built beacon node: chain + processor + API + slot timer."""
+
+    def __init__(self, chain, processor, api, slot_clock, timer):
+        self.chain = chain
+        self.processor = processor
+        self.api = api
+        self.slot_clock = slot_clock
+        self._timer = timer
+        self._stop = threading.Event()
+
+    def start(self):
+        if self.api is not None:
+            self.api.start()
+        self._timer.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self.api is not None:
+            self.api.stop()
+        self.processor.shutdown()
+
+
+class ClientBuilder:
+    def __init__(self, config: ClientConfig, spec: ChainSpec | None = None):
+        self.config = config
+        self.preset = PRESETS[config.preset_base]
+        self.spec = spec or (
+            ChainSpec() if config.preset_base == "mainnet" else _minimal()
+        )
+        self.types = types_for(self.preset)
+        self.genesis_state = None
+        self.slot_clock: SlotClock | None = None
+
+    def with_genesis_state(self, state):
+        self.genesis_state = state
+        return self
+
+    def with_interop_genesis(self, validator_count: int, genesis_time: int = 0):
+        from .state_transition import interop_genesis_state
+
+        self.genesis_state = interop_genesis_state(
+            self.preset, self.spec, validator_count, genesis_time=genesis_time
+        )
+        return self
+
+    def with_slot_clock(self, clock: SlotClock):
+        self.slot_clock = clock
+        return self
+
+    def build(self) -> Client:
+        cfg = self.config
+
+        # the north-star seam: runtime backend selection
+        from .crypto import backend as bls_backend
+
+        bls_backend.set_backend(cfg.bls_backend)
+
+        kv = (
+            SqliteStore(f"{cfg.datadir}/chain.sqlite")
+            if cfg.datadir
+            else MemoryStore()
+        )
+        store = HotColdDB(
+            kv,
+            self.types,
+            self.spec,
+            store_replayer(self.preset, self.spec),
+            slots_per_snapshot=cfg.slots_per_snapshot,
+        )
+
+        genesis = self.genesis_state
+        if genesis is None:
+            # resume from the store: anchor the chain at the persisted
+            # HEAD's post-state (reference resume path in
+            # ``client/src/builder.rs``: resume_from_db), not at genesis.
+            head_root = store.get_head()
+            anchor = None
+            if head_root is not None:
+                head_block = store.get_block(head_root)
+                if head_block is not None:
+                    anchor = store.get_state(bytes(head_block.message.state_root))
+            if anchor is None:
+                root = store.get_genesis_state_root()
+                if root is None:
+                    raise ValueError(
+                        "no genesis state provided and none found in the store"
+                    )
+                anchor = store.get_state(root)
+            genesis = anchor
+
+        clock = self.slot_clock or SystemTimeSlotClock(
+            genesis.genesis_time, self.spec.seconds_per_slot
+        )
+        chain = BeaconChain(
+            self.preset, self.spec, self.types, store, genesis, slot_clock=clock
+        )
+        chain.op_pool = OperationPool(self.preset, self.spec, self.types)
+
+        processor = _build_processor(chain, cfg.n_workers)
+        api = (
+            BeaconApiServer(chain, cfg.http_host, cfg.http_port)
+            if cfg.http_enabled
+            else None
+        )
+        stop = threading.Event()
+        timer = threading.Thread(
+            target=_slot_timer, args=(chain, clock, stop), daemon=True
+        )
+        client = Client(chain, processor, api, clock, timer)
+        client._stop = stop
+        return client
+
+
+def _build_processor(chain, n_workers: int) -> BeaconProcessor:
+    """Wire the gossip work kinds to the chain's batch verifiers
+    (reference ``worker/gossip_methods.rs`` entry points)."""
+
+    def on_attestation_batch(items):
+        results = chain.batch_verify_unaggregated_attestations_for_gossip(items)
+        for r in results:
+            if isinstance(r, VerifiedUnaggregatedAttestation):
+                chain.apply_attestation_to_fork_choice(r)
+                if chain.op_pool is not None:
+                    chain.op_pool.insert_attestation(r.attestation)
+        return results
+
+    def on_aggregate_batch(items):
+        results = chain.batch_verify_aggregated_attestations_for_gossip(items)
+        for r in results:
+            if isinstance(r, VerifiedAggregatedAttestation):
+                chain.apply_attestation_to_fork_choice(r)
+                if chain.op_pool is not None:
+                    chain.op_pool.insert_attestation(r.signed_aggregate.message.aggregate)
+        return results
+
+    def on_block(item):
+        gossip = chain.verify_block_for_gossip(item)
+        return chain.process_block(gossip)
+
+    def on_chain_segment(item):
+        return chain.process_chain_segment(item)
+
+    return BeaconProcessor(
+        {
+            WorkKind.GOSSIP_ATTESTATION: on_attestation_batch,
+            WorkKind.GOSSIP_AGGREGATE: on_aggregate_batch,
+            WorkKind.GOSSIP_BLOCK: on_block,
+            WorkKind.CHAIN_SEGMENT: on_chain_segment,
+        },
+        n_workers=n_workers,
+    )
+
+
+def _slot_timer(chain, clock, stop: threading.Event) -> None:
+    """Per-slot tick (reference ``timer/src/lib.rs``): advance fork
+    choice's clock and re-evaluate the head each slot, until stopped."""
+    last = -1
+    while not stop.is_set():
+        slot = clock.now()
+        if slot != last:
+            try:
+                chain.fork_choice.on_tick(slot)
+                chain.recompute_head()
+            except Exception:
+                pass
+            last = slot
+        stop.wait(min(1.0, max(0.05, clock.duration_to_next_slot())))
+
+
+def _minimal() -> ChainSpec:
+    from .types.chain_spec import minimal_spec
+
+    return minimal_spec()

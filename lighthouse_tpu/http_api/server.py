@@ -1,0 +1,477 @@
+"""Standard Beacon API over the stdlib threading HTTP server (reference:
+``beacon_node/http_api/src/lib.rs`` — one router over the chain; routes
+from ``:483``; plus the ``/metrics`` scrape endpoint of
+``beacon_node/http_metrics``).
+
+Routes implemented (the set the validator client + checkpoint sync
+consume):
+
+    GET  /eth/v1/node/health | /eth/v1/node/version | /eth/v1/node/syncing
+    GET  /eth/v1/beacon/genesis
+    GET  /eth/v1/beacon/states/{state_id}/root
+    GET  /eth/v1/beacon/states/{state_id}/fork
+    GET  /eth/v1/beacon/states/{state_id}/finality_checkpoints
+    GET  /eth/v1/beacon/states/{state_id}/validators
+    GET  /eth/v1/beacon/headers/{block_id}
+    GET  /eth/v2/beacon/blocks/{block_id}            (+ .ssz via Accept)
+    POST /eth/v1/beacon/blocks
+    GET/POST /eth/v1/beacon/pool/attestations
+    POST /eth/v1/beacon/pool/voluntary_exits
+    POST /eth/v1/beacon/pool/attester_slashings
+    POST /eth/v1/beacon/pool/proposer_slashings
+    GET  /eth/v1/config/spec
+    GET  /eth/v1/validator/duties/proposer/{epoch}
+    POST /eth/v1/validator/duties/attester/{epoch}
+    GET  /eth/v2/validator/blocks/{slot}
+    GET  /eth/v1/validator/attestation_data
+    GET  /eth/v1/validator/aggregate_attestation
+    POST /eth/v1/validator/aggregate_and_proofs
+    GET  /metrics
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from ..ssz import hash_tree_root
+from ..ssz.json import from_json, to_json
+from ..state_transition import (
+    get_beacon_committee,
+    get_beacon_proposer_index,
+    get_committee_count_per_slot,
+    partial_state_advance,
+)
+from ..state_transition.epoch import fork_of
+from ..utils import metrics
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+class BeaconApiServer:
+    """``chain`` is the BeaconChain; ``op_pool`` its pool. Runs on a
+    daemon thread; ``port=0`` picks a free port (tests)."""
+
+    def __init__(self, chain, host: str = "127.0.0.1", port: int = 5052):
+        self.chain = chain
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_GET(self):
+                outer._dispatch(self, "GET")
+
+            def do_POST(self):
+                outer._dispatch(self, "POST")
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True
+        )
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    # -- plumbing --------------------------------------------------------
+
+    def _dispatch(self, req, method: str) -> None:
+        url = urlparse(req.path)
+        # repeated params join to a comma list (the spec's ?id=1&id=2 and
+        # ?id=1,2 forms become equivalent)
+        query = {k: ",".join(v) for k, v in parse_qs(url.query).items()}
+        body = None
+        if method == "POST":
+            n = int(req.headers.get("Content-Length") or 0)
+            raw = req.rfile.read(n) if n else b""
+            body = json.loads(raw) if raw else None
+        try:
+            out = self._route(method, url.path, query, body)
+            if out is None:
+                payload, ctype = b"", "application/json"
+            elif isinstance(out, bytes):
+                payload, ctype = out, "application/octet-stream"
+            elif isinstance(out, str):
+                payload, ctype = out.encode(), "text/plain; charset=utf-8"
+            else:
+                payload, ctype = json.dumps(out).encode(), "application/json"
+            req.send_response(200)
+            req.send_header("Content-Type", ctype)
+            req.send_header("Content-Length", str(len(payload)))
+            req.end_headers()
+            req.wfile.write(payload)
+        except ApiError as e:
+            payload = json.dumps(
+                {"code": e.status, "message": e.message}
+            ).encode()
+            req.send_response(e.status)
+            req.send_header("Content-Type", "application/json")
+            req.send_header("Content-Length", str(len(payload)))
+            req.end_headers()
+            req.wfile.write(payload)
+        except Exception as e:  # internal error -> 500 with message
+            payload = json.dumps({"code": 500, "message": repr(e)}).encode()
+            try:
+                req.send_response(500)
+                req.send_header("Content-Type", "application/json")
+                req.send_header("Content-Length", str(len(payload)))
+                req.end_headers()
+                req.wfile.write(payload)
+            except Exception:
+                pass
+
+    # -- state/block resolution ------------------------------------------
+
+    def _state_for(self, state_id: str):
+        chain = self.chain
+        if state_id == "head":
+            return chain.head_state
+        if state_id == "genesis":
+            return chain.store.get_state(chain.store.get_genesis_state_root())
+        if state_id == "finalized":
+            _, root = chain.fork_choice.store.finalized_checkpoint
+            block = chain.store.get_block(root)
+            if block is None:
+                return chain.head_state
+            return chain.store.get_state(bytes(block.message.state_root))
+        if state_id.startswith("0x"):
+            st = chain.store.get_state(bytes.fromhex(state_id[2:]))
+            if st is None:
+                raise ApiError(404, f"state {state_id} not found")
+            return st
+        raise ApiError(400, f"unsupported state id {state_id!r}")
+
+    def _block_for(self, block_id: str):
+        chain = self.chain
+        if block_id == "head":
+            root = chain.head_block_root
+        elif block_id == "genesis":
+            root = chain.genesis_block_root
+        elif block_id == "finalized":
+            _, root = chain.fork_choice.store.finalized_checkpoint
+        elif block_id.startswith("0x"):
+            root = bytes.fromhex(block_id[2:])
+        else:
+            raise ApiError(400, f"unsupported block id {block_id!r}")
+        block = chain.store.get_block(root)
+        if block is None:
+            raise ApiError(404, f"block {block_id} not found")
+        return root, block
+
+    # -- router ----------------------------------------------------------
+
+    def _route(self, method, path, query, body):
+        chain = self.chain
+        t = chain.types
+
+        if path == "/eth/v1/node/health":
+            return None
+        if path == "/eth/v1/node/version":
+            return {"data": {"version": "lighthouse_tpu/0.2.0"}}
+        if path == "/eth/v1/node/syncing":
+            head_slot = chain.head_state.slot
+            current = chain.slot()
+            return {
+                "data": {
+                    "head_slot": str(head_slot),
+                    "sync_distance": str(max(0, current - head_slot)),
+                    "is_syncing": current > head_slot + 1,
+                    "is_optimistic": False,
+                    "el_offline": False,
+                }
+            }
+        if path == "/eth/v1/beacon/genesis":
+            st = chain.store.get_state(chain.store.get_genesis_state_root())
+            return {
+                "data": {
+                    "genesis_time": str(st.genesis_time),
+                    "genesis_validators_root": "0x"
+                    + bytes(st.genesis_validators_root).hex(),
+                    "genesis_fork_version": "0x"
+                    + bytes(chain.spec.genesis_fork_version).hex(),
+                }
+            }
+        if path == "/eth/v1/config/spec":
+            return {"data": chain.spec.to_api_dict(chain.preset)}
+        if path == "/metrics":
+            return metrics.gather()
+
+        m = re.fullmatch(r"/eth/v1/beacon/states/([^/]+)/root", path)
+        if m:
+            st = self._state_for(m.group(1))
+            return {"data": {"root": "0x" + hash_tree_root(st).hex()}}
+        m = re.fullmatch(r"/eth/v1/beacon/states/([^/]+)/fork", path)
+        if m:
+            st = self._state_for(m.group(1))
+            return {"data": to_json(type(st.fork), st.fork)}
+        m = re.fullmatch(
+            r"/eth/v1/beacon/states/([^/]+)/finality_checkpoints", path
+        )
+        if m:
+            st = self._state_for(m.group(1))
+            cp = lambda c: {"epoch": str(c.epoch), "root": "0x" + bytes(c.root).hex()}
+            return {
+                "data": {
+                    "previous_justified": cp(st.previous_justified_checkpoint),
+                    "current_justified": cp(st.current_justified_checkpoint),
+                    "finalized": cp(st.finalized_checkpoint),
+                }
+            }
+        m = re.fullmatch(r"/eth/v1/beacon/states/([^/]+)/validators", path)
+        if m:
+            st = self._state_for(m.group(1))
+            # spec allows ?id=1,2 and repeated ?id= params
+            ids = {
+                x
+                for chunk in query.get("id", "").split(",")
+                for x in [chunk.strip()]
+                if x
+            } or None
+            out = []
+            for i, (v, bal) in enumerate(zip(st.validators, st.balances)):
+                pk_hex = "0x" + bytes(v.pubkey).hex()
+                if ids is not None and str(i) not in ids and pk_hex not in ids:
+                    continue
+                out.append(
+                    {
+                        "index": str(i),
+                        "balance": str(bal),
+                        "status": _validator_status(chain.preset, st, v),
+                        "validator": to_json(type(v), v),
+                    }
+                )
+            return {"data": out}
+
+        m = re.fullmatch(r"/eth/v1/beacon/headers/([^/]+)", path)
+        if m:
+            root, block = self._block_for(m.group(1))
+            msg = block.message
+            header = {
+                "slot": str(msg.slot),
+                "proposer_index": str(msg.proposer_index),
+                "parent_root": "0x" + bytes(msg.parent_root).hex(),
+                "state_root": "0x" + bytes(msg.state_root).hex(),
+                "body_root": "0x" + hash_tree_root(msg.body).hex(),
+            }
+            return {
+                "data": {
+                    "root": "0x" + root.hex(),
+                    "canonical": True,
+                    "header": {
+                        "message": header,
+                        "signature": "0x" + bytes(block.signature).hex(),
+                    },
+                }
+            }
+        m = re.fullmatch(r"/eth/v2/beacon/blocks/([^/]+)", path)
+        if m:
+            root, block = self._block_for(m.group(1))
+            return {
+                "version": _fork_of_block(t, block),
+                "data": to_json(type(block), block),
+            }
+        if path == "/eth/v1/beacon/blocks" and method == "POST":
+            fork = body.get("version") if isinstance(body, dict) and "version" in body else None
+            payload = body["data"] if isinstance(body, dict) and "data" in body else body
+            fork = fork or fork_of(chain.head_state)
+            sb = from_json(t.signed_block[fork], payload)
+            try:
+                chain.process_block(sb)
+            except Exception as e:
+                raise ApiError(400, f"block rejected: {e}")
+            return None
+
+        if path == "/eth/v1/beacon/pool/attestations":
+            if method == "GET":
+                return {"data": []}  # pending pool dump (not tracked per-data)
+            results = []
+            for obj in body:
+                att = from_json(t.Attestation, obj)
+                try:
+                    v = chain.verify_unaggregated_attestation_for_gossip(att)
+                    chain.apply_attestation_to_fork_choice(v)
+                    if chain.op_pool is not None:
+                        chain.op_pool.insert_attestation(att)
+                except Exception as e:
+                    results.append(str(e))
+            if results:
+                raise ApiError(400, "; ".join(results))
+            return None
+        if path == "/eth/v1/beacon/pool/voluntary_exits" and method == "POST":
+            ex = from_json(t.SignedVoluntaryExit, body)
+            if chain.op_pool is not None:
+                chain.op_pool.insert_voluntary_exit(ex)
+            return None
+        if path == "/eth/v1/beacon/pool/attester_slashings" and method == "POST":
+            s = from_json(t.AttesterSlashing, body)
+            if chain.op_pool is not None:
+                chain.op_pool.insert_attester_slashing(s)
+            chain.fork_choice.on_attester_slashing(s.attestation_1, s.attestation_2)
+            return None
+        if path == "/eth/v1/beacon/pool/proposer_slashings" and method == "POST":
+            s = from_json(t.ProposerSlashing, body)
+            if chain.op_pool is not None:
+                chain.op_pool.insert_proposer_slashing(s)
+            return None
+
+        m = re.fullmatch(r"/eth/v1/validator/duties/proposer/(\d+)", path)
+        if m:
+            epoch = int(m.group(1))
+            P = chain.preset
+            import copy as _copy
+
+            from ..state_transition.helpers import proposer_index_at_slot
+
+            st = chain.head_state
+            start = epoch * P.SLOTS_PER_EPOCH
+            if st.slot < start:
+                st = partial_state_advance(P, chain.spec, _copy.deepcopy(st), start)
+            duties = []
+            for slot in range(start, start + P.SLOTS_PER_EPOCH):
+                proposer = proposer_index_at_slot(P, st, slot)
+                duties.append(
+                    {
+                        "pubkey": "0x"
+                        + bytes(st.validators[proposer].pubkey).hex(),
+                        "validator_index": str(proposer),
+                        "slot": str(slot),
+                    }
+                )
+            return {
+                "dependent_root": "0x" + chain.head_block_root.hex(),
+                "execution_optimistic": False,
+                "data": duties,
+            }
+        m = re.fullmatch(r"/eth/v1/validator/duties/attester/(\d+)", path)
+        if m:
+            epoch = int(m.group(1))
+            P = chain.preset
+            wanted = {int(i) for i in (body or [])}
+            st = chain.head_state
+            cache = chain.shuffling_cache.get(chain, epoch, chain.head_block_root)
+            duties = []
+            for slot in range(
+                epoch * P.SLOTS_PER_EPOCH, (epoch + 1) * P.SLOTS_PER_EPOCH
+            ):
+                for index in range(cache.committees_per_slot):
+                    committee = cache.committee(slot, index)
+                    for pos, vi in enumerate(committee):
+                        vi = int(vi)
+                        if wanted and vi not in wanted:
+                            continue
+                        duties.append(
+                            {
+                                "pubkey": "0x"
+                                + bytes(st.validators[vi].pubkey).hex(),
+                                "validator_index": str(vi),
+                                "committee_index": str(index),
+                                "committee_length": str(len(committee)),
+                                "committees_at_slot": str(
+                                    cache.committees_per_slot
+                                ),
+                                "validator_committee_index": str(pos),
+                                "slot": str(slot),
+                            }
+                        )
+            return {
+                "dependent_root": "0x" + chain.head_block_root.hex(),
+                "execution_optimistic": False,
+                "data": duties,
+            }
+        m = re.fullmatch(r"/eth/v2/validator/blocks/(\d+)", path)
+        if m:
+            slot = int(m.group(1))
+            randao = bytes.fromhex(query["randao_reveal"][2:])
+            graffiti = (
+                bytes.fromhex(query["graffiti"][2:])
+                if "graffiti" in query
+                else bytes(32)
+            )
+            block, _proposer = chain.produce_block_on_state(slot, randao, graffiti)
+            return {
+                "version": fork_of(chain.head_state),
+                "data": to_json(type(block), block),
+            }
+        if path == "/eth/v1/validator/attestation_data":
+            slot = int(query["slot"])
+            index = int(query["committee_index"])
+            data = chain.produce_unaggregated_attestation(slot, index)
+            return {"data": to_json(type(data), data)}
+        if path == "/eth/v1/validator/aggregate_attestation":
+            slot = int(query["slot"])
+            data_root = bytes.fromhex(query["attestation_data_root"][2:])
+            agg = _best_aggregate(chain, slot, data_root)
+            if agg is None:
+                raise ApiError(404, "no matching aggregate")
+            return {"data": to_json(type(agg), agg)}
+        if path == "/eth/v1/validator/aggregate_and_proofs" and method == "POST":
+            for obj in body:
+                sa = from_json(t.SignedAggregateAndProof, obj)
+                v = chain.verify_aggregated_attestation_for_gossip(sa)
+                chain.apply_attestation_to_fork_choice(v)
+                if chain.op_pool is not None:
+                    chain.op_pool.insert_attestation(sa.message.aggregate)
+            return None
+
+        raise ApiError(404, f"no route for {method} {path}")
+
+
+def _validator_status(P, state, v) -> str:
+    from ..types.chain_spec import FAR_FUTURE_EPOCH
+
+    epoch = state.slot // P.SLOTS_PER_EPOCH
+    if v.activation_epoch > epoch:
+        return (
+            "pending_queued"
+            if v.activation_eligibility_epoch != FAR_FUTURE_EPOCH
+            else "pending_initialized"
+        )
+    if epoch < v.exit_epoch:
+        return "active_slashed" if v.slashed else "active_ongoing"
+    if epoch < v.withdrawable_epoch:
+        return "exited_slashed" if v.slashed else "exited_unslashed"
+    return "withdrawal_possible"
+
+
+def _fork_of_block(t, signed_block) -> str:
+    for fork, cls in t.signed_block.items():
+        if isinstance(signed_block, cls):
+            return fork
+    return "phase0"
+
+
+def _best_aggregate(chain, slot: int, data_root: bytes):
+    """Best-coverage aggregate for (slot, data_root) from the op pool
+    (the naive-aggregation-pool read path)."""
+    pool = chain.op_pool
+    if pool is None:
+        return None
+    t = chain.types
+    with pool._lock:
+        entry = pool._attestations.get(bytes(data_root))
+        if entry is None:
+            return None
+        data, groups = entry
+        if data.slot != slot or not groups:
+            return None
+        best = max(groups, key=lambda g: sum(g.aggregation_bits))
+        return t.Attestation(
+            aggregation_bits=list(best.aggregation_bits),
+            data=data,
+            signature=best.signature,
+        )

@@ -227,10 +227,16 @@ def compute_proposer_index(preset: Preset, state, indices, seed: bytes) -> int:
 
 
 def get_beacon_proposer_index(preset: Preset, state) -> int:
-    epoch = get_current_epoch(preset, state)
+    return proposer_index_at_slot(preset, state, state.slot)
+
+
+def proposer_index_at_slot(preset: Preset, state, slot: int) -> int:
+    """Proposer for any slot of the state's current epoch — usable for
+    whole-epoch duty queries without advancing the state per slot."""
+    epoch = compute_epoch_at_slot(preset, slot)
     seed = _h(
         get_seed(preset, state, epoch, 0)  # DOMAIN_BEACON_PROPOSER
-        + state.slot.to_bytes(8, "little")
+        + int(slot).to_bytes(8, "little")
     )
     indices = get_active_validator_indices(state, epoch)
     return compute_proposer_index(preset, state, indices, seed)

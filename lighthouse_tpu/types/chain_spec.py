@@ -95,6 +95,30 @@ class ChainSpec:
     def fork_version_at_epoch(self, epoch: int) -> bytes:
         return self.fork_version_for(self.fork_name_at_epoch(epoch))
 
+    def to_api_dict(self, preset=None) -> dict:
+        """Beacon-API ``/eth/v1/config/spec`` shape: UPPER_SNAKE keys,
+        stringified ints, 0x-hex bytes (reference serde of ChainSpec +
+        preset into one flat map)."""
+        import dataclasses
+
+        out = {}
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            key = f.name.upper()
+            if isinstance(v, bytes):
+                out[key] = "0x" + v.hex()
+            elif isinstance(v, bool):
+                out[key] = str(int(v))
+            elif v is None:
+                continue
+            else:
+                out[key] = str(v)
+        if preset is not None:
+            for name in dir(preset):
+                if name.isupper():
+                    out[name] = str(getattr(preset, name))
+        return out
+
 
 def mainnet_spec() -> ChainSpec:
     return ChainSpec()

@@ -1,0 +1,31 @@
+"""L7: validator client — duties/attestation/block services,
+slashing-protected ValidatorStore, doppelganger protection, multi-BN
+fallback.
+
+Reference: ``validator_client/`` (SURVEY.md §2.5).
+"""
+
+from .services import (
+    AttestationService,
+    AttesterDuty,
+    BeaconNodeFallback,
+    BlockService,
+    DoppelgangerService,
+    DutiesService,
+    ProposerDuty,
+    ValidatorClient,
+)
+from .validator_store import InitializedValidator, ValidatorStore
+
+__all__ = [
+    "AttestationService",
+    "AttesterDuty",
+    "BeaconNodeFallback",
+    "BlockService",
+    "DoppelgangerService",
+    "DutiesService",
+    "InitializedValidator",
+    "ProposerDuty",
+    "ValidatorClient",
+    "ValidatorStore",
+]

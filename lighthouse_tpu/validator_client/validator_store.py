@@ -1,0 +1,151 @@
+"""ValidatorStore: decrypted keys + slashing-protected signing (reference:
+``validator_client/src/validator_store.rs`` + ``signing_method.rs`` —
+every signature passes through the slashing DB first).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+from ..crypto import bls
+from ..keys import SlashingDatabase, SlashingProtectionError, decrypt
+from ..ssz import Uint64, hash_tree_root
+from ..types.chain_spec import (
+    DOMAIN_AGGREGATE_AND_PROOF,
+    DOMAIN_BEACON_ATTESTER,
+    DOMAIN_BEACON_PROPOSER,
+    DOMAIN_RANDAO,
+    DOMAIN_SELECTION_PROOF,
+    DOMAIN_VOLUNTARY_EXIT,
+)
+from ..types.domains import compute_domain, compute_signing_root
+
+
+@dataclass
+class InitializedValidator:
+    """A loaded, enabled validator (reference initialized_validators.rs)."""
+
+    secret_key: bls.SecretKey
+    pubkey: bytes
+    index: Optional[int] = None  # validator index once known on-chain
+    enabled: bool = True
+
+
+class ValidatorStore:
+    def __init__(
+        self,
+        spec,
+        preset,
+        types,
+        genesis_validators_root: bytes,
+        slashing_db: SlashingDatabase | None = None,
+    ):
+        self.spec = spec
+        self.preset = preset
+        self.t = types
+        self.genesis_validators_root = genesis_validators_root
+        self.slashing_db = slashing_db or SlashingDatabase(
+            genesis_validators_root=genesis_validators_root
+        )
+        self._validators: dict[bytes, InitializedValidator] = {}
+        self._lock = threading.Lock()
+
+    # -- key management --------------------------------------------------
+
+    def add_secret_key(self, sk: bls.SecretKey) -> bytes:
+        pk = sk.public_key().serialize()
+        with self._lock:
+            self._validators[pk] = InitializedValidator(sk, pk)
+        self.slashing_db.register_validator(pk)
+        return pk
+
+    def add_keystore(self, keystore: dict, password: str) -> bytes:
+        sk_bytes = decrypt(keystore, password)
+        return self.add_secret_key(
+            bls.SecretKey(int.from_bytes(sk_bytes, "big"))
+        )
+
+    def remove(self, pubkey: bytes) -> bool:
+        with self._lock:
+            return self._validators.pop(pubkey, None) is not None
+
+    def pubkeys(self) -> list[bytes]:
+        with self._lock:
+            return [p for p, v in self._validators.items() if v.enabled]
+
+    def set_index(self, pubkey: bytes, index: int) -> None:
+        with self._lock:
+            if pubkey in self._validators:
+                self._validators[pubkey].index = index
+
+    def index_of(self, pubkey: bytes) -> Optional[int]:
+        with self._lock:
+            v = self._validators.get(pubkey)
+            return v.index if v else None
+
+    def _sk(self, pubkey: bytes) -> bls.SecretKey:
+        with self._lock:
+            v = self._validators.get(pubkey)
+        if v is None or not v.enabled:
+            raise KeyError(f"unknown/disabled validator {pubkey.hex()[:12]}")
+        return v.secret_key
+
+    # -- domains ---------------------------------------------------------
+
+    def _domain(self, domain_type: int, epoch: int) -> bytes:
+        version = self.spec.fork_version_at_epoch(epoch)
+        return compute_domain(
+            self.spec, domain_type, version, self.genesis_validators_root
+        )
+
+    # -- signing (every path slashing-protected where applicable) --------
+
+    def sign_block(self, pubkey: bytes, block):
+        epoch = block.slot // self.preset.SLOTS_PER_EPOCH
+        domain = self._domain(DOMAIN_BEACON_PROPOSER, epoch)
+        root = compute_signing_root(type(block), block, domain)
+        self.slashing_db.check_and_insert_block_proposal(
+            pubkey, block.slot, root
+        )
+        sig = self._sk(pubkey).sign(root)
+        fork = self.spec.fork_name_at_epoch(epoch)
+        return self.t.signed_block[fork](message=block, signature=sig.serialize())
+
+    def sign_attestation(self, pubkey: bytes, data):
+        domain = self._domain(DOMAIN_BEACON_ATTESTER, data.target.epoch)
+        root = compute_signing_root(type(data), data, domain)
+        self.slashing_db.check_and_insert_attestation(
+            pubkey, data.source.epoch, data.target.epoch, root
+        )
+        return self._sk(pubkey).sign(root).serialize()
+
+    def randao_reveal(self, pubkey: bytes, epoch: int) -> bytes:
+        domain = self._domain(DOMAIN_RANDAO, epoch)
+        root = compute_signing_root(Uint64, epoch, domain)
+        return self._sk(pubkey).sign(root).serialize()
+
+    def selection_proof(self, pubkey: bytes, slot: int) -> bytes:
+        epoch = slot // self.preset.SLOTS_PER_EPOCH
+        domain = self._domain(DOMAIN_SELECTION_PROOF, epoch)
+        root = compute_signing_root(Uint64, slot, domain)
+        return self._sk(pubkey).sign(root).serialize()
+
+    def sign_aggregate_and_proof(self, pubkey: bytes, aggregate_and_proof):
+        epoch = aggregate_and_proof.aggregate.data.target.epoch
+        domain = self._domain(DOMAIN_AGGREGATE_AND_PROOF, epoch)
+        root = compute_signing_root(
+            type(aggregate_and_proof), aggregate_and_proof, domain
+        )
+        return self.t.SignedAggregateAndProof(
+            message=aggregate_and_proof,
+            signature=self._sk(pubkey).sign(root).serialize(),
+        )
+
+    def sign_voluntary_exit(self, pubkey: bytes, exit_msg):
+        domain = self._domain(DOMAIN_VOLUNTARY_EXIT, exit_msg.epoch)
+        root = compute_signing_root(type(exit_msg), exit_msg, domain)
+        return self.t.SignedVoluntaryExit(
+            message=exit_msg, signature=self._sk(pubkey).sign(root).serialize()
+        )

@@ -9,6 +9,8 @@ cases of ``verify_signature_sets``
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import jax.numpy as jnp
 
 from lighthouse_tpu.crypto import bls

@@ -3,6 +3,8 @@
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from lighthouse_tpu.crypto.cpu import pairing as cpu_pairing
 from lighthouse_tpu.crypto.cpu.curve import G1Point, G2Point, g1_generator, g2_generator
 from lighthouse_tpu.crypto.cpu.fields import Fq, Fq2, Fq6, Fq12

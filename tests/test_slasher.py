@@ -1,0 +1,140 @@
+"""Slasher: double votes, surround detection both ways, block doubles,
+queue batching — scenarios mirroring ``slasher/tests/`` + the
+min-max-span property (randomized cross-check vs brute force)."""
+
+import random
+
+import pytest
+
+from lighthouse_tpu.slasher import AttesterSlashingStatus, Slasher
+from lighthouse_tpu.state_transition.helpers import is_slashable_attestation_data
+from lighthouse_tpu.types.containers import types_for
+from lighthouse_tpu.types.preset import MINIMAL
+
+T = types_for(MINIMAL)
+
+
+def _att(validators, source, target, root=b"\x01" * 32):
+    return T.IndexedAttestation(
+        attesting_indices=list(validators),
+        data=T.AttestationData(
+            slot=target * MINIMAL.SLOTS_PER_EPOCH,
+            index=0,
+            beacon_block_root=root,
+            source=T.Checkpoint(epoch=source, root=b"\x0a" * 32),
+            target=T.Checkpoint(epoch=target, root=root),
+        ),
+        signature=b"\x00" * 96,
+    )
+
+
+def test_not_slashable_disjoint_and_repeat():
+    s = Slasher(T)
+    assert s.check_attestation(_att([1], 0, 1)) == []
+    assert s.check_attestation(_att([1], 1, 2)) == []
+    # identical attestation again: no slashing
+    assert s.check_attestation(_att([1], 0, 1)) == []
+
+
+def test_double_vote():
+    s = Slasher(T)
+    s.check_attestation(_att([1], 0, 3, root=b"\x01" * 32))
+    out = s.check_attestation(_att([1], 2, 3, root=b"\x02" * 32))
+    assert out and out[0][0] == AttesterSlashingStatus.DOUBLE_VOTE
+    sl = out[0][1]
+    assert is_slashable_attestation_data(
+        sl.attestation_1.data, sl.attestation_2.data
+    )
+
+
+def test_new_surrounds_existing():
+    s = Slasher(T)
+    s.check_attestation(_att([7], 3, 4))
+    out = s.check_attestation(_att([7], 2, 6))
+    assert out and out[0][0] == AttesterSlashingStatus.SURROUNDS_EXISTING
+    sl = out[0][1]
+    # spec ordering: attestation_1 surrounds attestation_2
+    assert is_slashable_attestation_data(
+        sl.attestation_1.data, sl.attestation_2.data
+    )
+
+
+def test_new_surrounded_by_existing():
+    s = Slasher(T)
+    s.check_attestation(_att([7], 2, 6))
+    out = s.check_attestation(_att([7], 3, 4))
+    assert out and out[0][0] == AttesterSlashingStatus.SURROUNDED_BY_EXISTING
+    sl = out[0][1]
+    assert is_slashable_attestation_data(
+        sl.attestation_1.data, sl.attestation_2.data
+    )
+
+
+def test_only_common_validators_flagged():
+    s = Slasher(T)
+    s.check_attestation(_att([1, 2], 3, 4))
+    out = s.check_attestation(_att([3], 2, 6))
+    assert out == []  # validator 3 never voted inside
+
+
+def test_block_double_proposal():
+    s = Slasher(T)
+    h1 = T.SignedBeaconBlockHeader(
+        message=T.BeaconBlockHeader(slot=9, proposer_index=4, body_root=b"\x01" * 32),
+        signature=b"\x00" * 96,
+    )
+    h2 = T.SignedBeaconBlockHeader(
+        message=T.BeaconBlockHeader(slot=9, proposer_index=4, body_root=b"\x02" * 32),
+        signature=b"\x00" * 96,
+    )
+    assert s.check_block_header(h1) is None
+    assert s.check_block_header(h1) is None  # same header again
+    sl = s.check_block_header(h2)
+    assert sl is not None
+    assert sl.signed_header_1.message.slot == sl.signed_header_2.message.slot
+
+
+def test_queue_batching_and_callback():
+    found = []
+    s = Slasher(T, on_slashing=lambda *a: found.append(a))
+    s.accept_attestation(_att([5], 3, 4))
+    s.accept_attestation(_att([5], 2, 6))
+    n = s.process_queued()
+    assert n == 1 and len(found) == 1
+    assert s.found_attester_slashings
+
+
+def test_randomized_against_bruteforce():
+    """Property check: span-based detection fires iff a brute-force scan
+    over all prior votes finds a double/surround pair."""
+    rng = random.Random(1234)
+    s = Slasher(T, history_length=64)
+    history: list[tuple[int, int, bytes]] = []
+    for i in range(300):
+        src = rng.randrange(0, 30)
+        tgt = src + rng.randrange(1, 10)
+        root = bytes([rng.randrange(2)]) * 32
+        expect = False
+        for ps, pt, pr in history:
+            # spec double vote: same target epoch, ANY data difference
+            if pt == tgt and (pr != root or ps != src):
+                expect = True
+            if (src < ps and tgt > pt) or (ps < src and pt > tgt):
+                expect = True
+        got = s.check_attestation(_att([9], src, tgt, root=root))
+        assert bool(got) == expect, (
+            f"step {i}: ({src},{tgt},{root[:1].hex()}) got={bool(got)} expect={expect}"
+        )
+        if not any(h[0] == src and h[1] == tgt and h[2] == root for h in history):
+            history.append((src, tgt, root))
+
+
+def test_sliding_window_high_epochs():
+    """Surround detection still works past history_length (the window
+    slides; the reference's chunked arrays do the same)."""
+    s = Slasher(T, history_length=64)
+    s.check_attestation(_att([3], 5000, 5001))
+    out = s.check_attestation(_att([3], 4999, 5002))
+    assert out and out[0][0] == AttesterSlashingStatus.SURROUNDS_EXISTING
+    out = s.check_attestation(_att([3], 5000, 5001, root=b"\x05" * 32))
+    assert out and out[0][0] == AttesterSlashingStatus.DOUBLE_VOTE
