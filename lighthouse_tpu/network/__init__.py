@@ -1,0 +1,23 @@
+"""L5 networking: gossip pub/sub, req/resp RPC, peer exchange, range
+sync over TCP with SSZ-snappy payloads.
+
+Reference: ``beacon_node/lighthouse_network`` (libp2p behaviour) +
+``beacon_node/network`` (router, sync) — SURVEY.md §2.4 rows 18-19.
+"""
+
+from .service import (
+    ATTESTATION_SUBNET_COUNT,
+    NetworkService,
+    RangeSync,
+    Topics,
+)
+from .transport import Peer, Transport
+
+__all__ = [
+    "ATTESTATION_SUBNET_COUNT",
+    "NetworkService",
+    "Peer",
+    "RangeSync",
+    "Topics",
+    "Transport",
+]

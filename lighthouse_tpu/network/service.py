@@ -1,0 +1,416 @@
+"""Network service: gossip topics, req/resp protocols, peer exchange,
+router into the BeaconProcessor, and range sync.
+
+Reference mapping (SURVEY.md §2.4):
+
+* topics mirror ``lighthouse_network/src/types/topics.rs:47-72``:
+  ``/eth2/{fork_digest}/beacon_block/ssz_snappy``,
+  ``.../beacon_aggregate_and_proof/...``,
+  ``.../beacon_attestation_{subnet}/...``, voluntary_exit, slashings;
+* req/resp protocols mirror ``rpc/protocol.rs:143-155``: status, goodbye,
+  ping, metadata, beacon_blocks_by_range, beacon_blocks_by_root;
+* the Router + work queues mirror ``network/src/router`` +
+  ``beacon_processor`` (gossip items become Work batches);
+* discovery is peer-exchange over an extra ``peers`` protocol (discv5's
+  niche: learning listen addresses of more peers) + static bootnodes;
+* range sync mirrors ``network/src/sync/range_sync``: on a Status showing
+  a peer ahead, batches of blocks_by_range feed CHAIN_SEGMENT work.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import threading
+import time
+from typing import Optional
+
+from ..beacon_processor import BeaconProcessor, Work, WorkKind
+from ..ssz import hash_tree_root
+from ..state_transition.epoch import fork_of
+from ..types.domains import compute_fork_digest
+from ..utils import metrics
+from .transport import Peer, Transport
+
+_GOSSIP_RX = metrics.counter("network_gossip_received_total")
+_GOSSIP_TX = metrics.counter("network_gossip_published_total")
+
+ATTESTATION_SUBNET_COUNT = 64
+
+
+class Topics:
+    def __init__(self, fork_digest: bytes):
+        self.prefix = f"/eth2/{fork_digest.hex()}"
+
+    def block(self) -> str:
+        return f"{self.prefix}/beacon_block/ssz_snappy"
+
+    def aggregate(self) -> str:
+        return f"{self.prefix}/beacon_aggregate_and_proof/ssz_snappy"
+
+    def attestation(self, subnet: int) -> str:
+        return f"{self.prefix}/beacon_attestation_{subnet}/ssz_snappy"
+
+    def voluntary_exit(self) -> str:
+        return f"{self.prefix}/voluntary_exit/ssz_snappy"
+
+    def attester_slashing(self) -> str:
+        return f"{self.prefix}/attester_slashing/ssz_snappy"
+
+    def proposer_slashing(self) -> str:
+        return f"{self.prefix}/proposer_slashing/ssz_snappy"
+
+
+PROTO_STATUS = "/eth2/beacon_chain/req/status/1"
+PROTO_GOODBYE = "/eth2/beacon_chain/req/goodbye/1"
+PROTO_PING = "/eth2/beacon_chain/req/ping/1"
+PROTO_METADATA = "/eth2/beacon_chain/req/metadata/1"
+PROTO_BLOCKS_BY_RANGE = "/eth2/beacon_chain/req/beacon_blocks_by_range/1"
+PROTO_BLOCKS_BY_ROOT = "/eth2/beacon_chain/req/beacon_blocks_by_root/1"
+PROTO_PEER_EXCHANGE = "/eth2/beacon_chain/req/peers/1"
+
+
+class NetworkService:
+    """Wires a BeaconChain + BeaconProcessor to the transport."""
+
+    def __init__(
+        self,
+        chain,
+        processor: BeaconProcessor,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        subnets: Optional[set[int]] = None,
+    ):
+        self.chain = chain
+        self.processor = processor
+        self.subnets = subnets if subnets is not None else set(range(ATTESTATION_SUBNET_COUNT))
+        gvr = bytes(chain.head_state.genesis_validators_root)
+        # One Topics per scheduled fork: gossip is ACCEPTED for any of
+        # them, PUBLISHED on the wall-clock epoch's digest, so nodes on
+        # either side of a fork transition still exchange messages.
+        self._topics_by_fork = {
+            fork: Topics(compute_fork_digest(
+                chain.spec, chain.spec.fork_version_for(fork), gvr
+            ))
+            for fork in ("phase0", "altair", "bellatrix")
+        }
+        self.transport = Transport(host, port)
+        self.transport.on_gossip = self._on_gossip
+        self.transport.on_request = self._on_request
+        self.transport.on_peer_connected = self._on_peer_connected
+        self._seen: dict[bytes, float] = {}  # gossip message-id dedup
+        self._seen_lock = threading.Lock()
+        self.sync = RangeSync(self)
+
+    @property
+    def topics(self) -> Topics:
+        """Topics for the current wall-clock epoch's fork digest."""
+        return self._topics_by_fork[
+            self.chain.spec.fork_name_at_epoch(self.chain.epoch())
+        ]
+
+    # -- lifecycle -------------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        return self.transport.port
+
+    def connect(self, host: str, port: int) -> Optional[Peer]:
+        return self.transport.dial(host, port)
+
+    def close(self) -> None:
+        self.transport.close()
+
+    # -- gossip out ------------------------------------------------------
+
+    def publish_block(self, signed_block) -> None:
+        self._publish(self.topics.block(), type(signed_block).encode(signed_block))
+
+    def publish_attestation(self, attestation, subnet: int) -> None:
+        self._publish(
+            self.topics.attestation(subnet % ATTESTATION_SUBNET_COUNT),
+            type(attestation).encode(attestation),
+        )
+
+    def publish_aggregate(self, signed_aggregate) -> None:
+        self._publish(
+            self.topics.aggregate(), type(signed_aggregate).encode(signed_aggregate)
+        )
+
+    def publish_voluntary_exit(self, signed_exit) -> None:
+        self._publish(
+            self.topics.voluntary_exit(), type(signed_exit).encode(signed_exit)
+        )
+
+    def publish_attester_slashing(self, slashing) -> None:
+        self._publish(
+            self.topics.attester_slashing(), type(slashing).encode(slashing)
+        )
+
+    def publish_proposer_slashing(self, slashing) -> None:
+        self._publish(
+            self.topics.proposer_slashing(), type(slashing).encode(slashing)
+        )
+
+    def _publish(self, topic: str, payload: bytes) -> None:
+        self._mark_seen(topic, payload)
+        _GOSSIP_TX.inc()
+        self.transport.publish(topic, payload)
+
+    # -- gossip in -------------------------------------------------------
+
+    def _msg_id(self, topic: str, payload: bytes) -> bytes:
+        from ..ssz.sha256 import hash_bytes
+
+        return hash_bytes(topic.encode() + payload)[:20]
+
+    def _mark_seen(self, topic: str, payload: bytes) -> bool:
+        """True if already seen. Prunes entries older than 10 minutes."""
+        mid = self._msg_id(topic, payload)
+        now = time.monotonic()
+        with self._seen_lock:
+            if mid in self._seen:
+                return True
+            self._seen[mid] = now
+            if len(self._seen) > 1 << 16:
+                cutoff = now - 600
+                self._seen = {
+                    k: ts for k, ts in self._seen.items() if ts > cutoff
+                }
+            return False
+
+    def _on_gossip(self, peer: Peer, topic: str, payload: bytes) -> None:
+        if self._mark_seen(topic, payload):
+            return
+        _GOSSIP_RX.inc()
+        t = self.chain.types
+        # match against every scheduled fork's topic set
+        kinds = {}
+        for tp in self._topics_by_fork.values():
+            kinds[tp.block()] = "block"
+            kinds[tp.aggregate()] = "aggregate"
+            kinds[tp.voluntary_exit()] = "voluntary_exit"
+            kinds[tp.attester_slashing()] = "attester_slashing"
+            kinds[tp.proposer_slashing()] = "proposer_slashing"
+        kind = kinds.get(topic)
+        if kind is None and "/beacon_attestation_" in topic:
+            kind = "attestation"
+        try:
+            if kind == "block":
+                fork = fork_of(self.chain.head_state)
+                sb = t.signed_block[fork].decode(payload)
+                self.processor.submit(
+                    Work(WorkKind.GOSSIP_BLOCK, sb, done=self._after_block)
+                )
+            elif kind == "aggregate":
+                sa = t.SignedAggregateAndProof.decode(payload)
+                self.processor.submit(Work(WorkKind.GOSSIP_AGGREGATE, sa))
+            elif kind == "attestation":
+                att = t.Attestation.decode(payload)
+                self.processor.submit(Work(WorkKind.GOSSIP_ATTESTATION, att))
+            elif kind == "voluntary_exit":
+                ex = t.SignedVoluntaryExit.decode(payload)
+                if self.chain.op_pool is not None:
+                    self.chain.op_pool.insert_voluntary_exit(ex)
+            elif kind == "attester_slashing":
+                sl = t.AttesterSlashing.decode(payload)
+                if self.chain.op_pool is not None:
+                    self.chain.op_pool.insert_attester_slashing(sl)
+            elif kind == "proposer_slashing":
+                sl = t.ProposerSlashing.decode(payload)
+                if self.chain.op_pool is not None:
+                    self.chain.op_pool.insert_proposer_slashing(sl)
+            else:
+                return
+        except Exception:
+            return  # undecodable gossip: drop (scoring would penalize)
+        # forward to the mesh (flood-publish, minus the sender)
+        self.transport.publish(topic, payload, exclude=peer)
+
+    def _after_block(self, result) -> None:
+        """Unknown-parent blocks trigger sync; others are done."""
+        from ..beacon_chain import BlockError
+
+        if isinstance(result, BlockError) and result.kind == "ParentUnknown":
+            self.sync.trigger()
+
+    # -- req/resp --------------------------------------------------------
+
+    def _on_peer_connected(self, peer: Peer) -> None:
+        # handshake: status + peer exchange, off-thread (dial returns fast)
+        threading.Thread(
+            target=self._handshake, args=(peer,), daemon=True
+        ).start()
+
+    def _handshake(self, peer: Peer) -> None:
+        status = peer.request(
+            PROTO_STATUS.encode(), json.dumps(self.local_status()).encode()
+        )
+        if status:
+            try:
+                self.sync.on_status(peer, json.loads(status))
+            except (ValueError, KeyError):
+                pass
+        px = peer.request(PROTO_PEER_EXCHANGE.encode(), b"[]")
+        if px:
+            try:
+                for host, port in json.loads(px):
+                    if port != self.port and self.transport.peer_count() < 32:
+                        self.transport.dial(host, port)
+            except (ValueError, TypeError):
+                pass
+
+    def local_status(self) -> dict:
+        """Status payload (reference StatusMessage)."""
+        chain = self.chain
+        fin = chain.fork_choice.store.finalized_checkpoint
+        return {
+            "fork_digest": self.topics.prefix.split("/")[-1],
+            "finalized_epoch": fin[0],
+            "finalized_root": fin[1].hex(),
+            "head_slot": chain.head_state.slot,
+            "head_root": chain.head_block_root.hex(),
+            "listen_port": self.port,
+        }
+
+    def _on_request(self, peer: Peer, protocol: str, payload: bytes) -> bytes:
+        chain = self.chain
+        if protocol == PROTO_STATUS:
+            try:
+                theirs = json.loads(payload)
+                peer.remote_listen_port = theirs.get("listen_port")
+                self.sync.on_status(peer, theirs)
+            except (ValueError, KeyError):
+                pass
+            return json.dumps(self.local_status()).encode()
+        if protocol == PROTO_PING or protocol == PROTO_GOODBYE:
+            return b"pong"
+        if protocol == PROTO_METADATA:
+            return json.dumps(
+                {"attnets": sorted(self.subnets), "seq_number": 0}
+            ).encode()
+        if protocol == PROTO_PEER_EXCHANGE:
+            peers = [
+                [p.addr[0], p.remote_listen_port]
+                for p in self.transport.peers
+                if p.remote_listen_port
+            ]
+            return json.dumps(peers).encode()
+        if protocol == PROTO_BLOCKS_BY_RANGE:
+            start, count = struct.unpack("<QQ", payload[:16])
+            out = []
+            from ..store.iter import block_roots_iter
+
+            wanted = range(start, start + min(count, 64))
+            roots = {}
+            for slot, root in block_roots_iter(chain.store, chain.head_block_root):
+                if slot < start:
+                    break
+                if slot in wanted:
+                    roots[slot] = root
+            for slot in sorted(roots):
+                block = chain.store.get_block(roots[slot])
+                if block is not None:
+                    enc = type(block).encode(block)
+                    out.append(struct.pack("<I", len(enc)) + enc)
+            return b"".join(out)
+        if protocol == PROTO_BLOCKS_BY_ROOT:
+            out = []
+            for i in range(0, len(payload), 32):
+                block = chain.store.get_block(payload[i:i + 32])
+                if block is not None:
+                    enc = type(block).encode(block)
+                    out.append(struct.pack("<I", len(enc)) + enc)
+            return b"".join(out)
+        return b""
+
+
+class RangeSync:
+    """Forward range sync (reference ``network/src/sync/range_sync``):
+    when a peer's status is ahead, pull batches of blocks_by_range and
+    feed them as CHAIN_SEGMENT work until caught up."""
+
+    BATCH = 32
+
+    def __init__(self, service: NetworkService):
+        self.service = service
+        self._lock = threading.Lock()
+        self._active = False
+        self._best: Optional[tuple[int, Peer]] = None  # (head_slot, peer)
+
+    def on_status(self, peer: Peer, status: dict) -> None:
+        their_head = int(status.get("head_slot", 0))
+        with self._lock:
+            best = self._best
+            if (
+                best is None
+                or their_head > best[0]
+                or best[1].closed  # a dead best peer must never wedge sync
+            ):
+                self._best = (their_head, peer)
+        if their_head > self.service.chain.head_state.slot:
+            self.trigger()
+
+    def trigger(self) -> None:
+        with self._lock:
+            if self._active:
+                return
+            self._active = True
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def _run(self) -> None:
+        try:
+            chain = self.service.chain
+            while True:
+                with self._lock:
+                    best = self._best
+                if best is None or best[0] <= chain.head_state.slot:
+                    return
+                target_slot, peer = best
+                if peer.closed:
+                    with self._lock:
+                        if self._best is best:
+                            self._best = None
+                    return
+                start = chain.head_state.slot + 1
+                payload = struct.pack("<QQ", start, self.BATCH)
+                raw = peer.request(PROTO_BLOCKS_BY_RANGE.encode(), payload, timeout=30)
+                if not raw:
+                    with self._lock:
+                        if self._best is best:
+                            self._best = None  # failed peer: re-learn from statuses
+                    return
+                blocks = self._decode_blocks(raw)
+                if not blocks:
+                    return
+                done = threading.Event()
+                result = {}
+
+                def _done(r, _ev=done, _res=result):
+                    _res["r"] = r
+                    _ev.set()
+
+                self.service.processor.submit(
+                    Work(WorkKind.CHAIN_SEGMENT, blocks, done=_done)
+                )
+                if not done.wait(timeout=60):
+                    return
+                if isinstance(result.get("r"), Exception):
+                    return
+        finally:
+            with self._lock:
+                self._active = False
+
+    def _decode_blocks(self, raw: bytes) -> list:
+        t = self.service.chain.types
+        fork = fork_of(self.service.chain.head_state)
+        out = []
+        i = 0
+        while i + 4 <= len(raw):
+            (n,) = struct.unpack_from("<I", raw, i)
+            i += 4
+            if i + n > len(raw):
+                break
+            out.append(t.signed_block[fork].decode(raw[i:i + n]))
+            i += n
+        return out

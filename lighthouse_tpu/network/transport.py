@@ -1,0 +1,238 @@
+"""TCP transport: framed, snappy-compressed messages between peers.
+
+Reference: ``beacon_node/lighthouse_network`` — libp2p over TCP with
+gossipsub (snappy-compressed SSZ payloads) and SSZ-snappy req/resp
+(``src/rpc/protocol.rs:143-220``, codec ``rpc/codec/ssz_snappy.rs``).
+
+This transport keeps the reference's WIRE SEMANTICS (topic strings,
+SSZ-snappy payloads, request/response protocol names) over a simple
+length-prefixed TCP framing instead of libp2p's multistream negotiation:
+
+    frame := u32-le total_len | u8 kind | u16-le name_len | u32-le req_id
+             | name | payload
+
+kind: 0 = gossip publish (name = topic, req_id = 0), 1 = rpc request,
+2 = rpc response (req_id echoes the request so late responses can never
+be mis-delivered to a newer request). Payloads are snappy raw blocks.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from ..utils import snappy
+
+KIND_GOSSIP = 0
+KIND_REQUEST = 1
+KIND_RESPONSE = 2
+
+_HDR = struct.Struct("<IBHI")
+MAX_FRAME = 1 << 24  # 16 MiB ceiling, like the reference's max_chunk_size
+
+
+class Peer:
+    """One connected remote; owns the socket + reader thread."""
+
+    def __init__(self, sock: socket.socket, addr, on_frame, on_close):
+        self.sock = sock
+        self.addr = addr
+        self.remote_listen_port: Optional[int] = None
+        self._on_frame = on_frame
+        self._on_close = on_close
+        self._send_lock = threading.Lock()
+        self._req_lock = threading.Lock()
+        self._state_lock = threading.Lock()
+        self._req_counter = 0
+        self._pending_id: Optional[int] = None
+        self._pending_ev: Optional[threading.Event] = None
+        self._response: Optional[bytes] = None
+        self._closed = False
+        self._thread = threading.Thread(target=self._read_loop, daemon=True)
+        self._thread.start()
+
+    # -- sending ---------------------------------------------------------
+
+    def send(self, kind: int, name: bytes, payload: bytes, req_id: int = 0) -> bool:
+        comp = snappy.compress_raw(payload)
+        frame = _HDR.pack(1 + 2 + 4 + len(name) + len(comp), kind, len(name), req_id)
+        try:
+            with self._send_lock:
+                self.sock.sendall(frame + name + comp)
+            return True
+        except OSError:
+            self.close()
+            return False
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def request(self, protocol: bytes, payload: bytes, timeout: float = 10.0) -> Optional[bytes]:
+        """One in-flight request per peer (the reference serializes per
+        substream; we serialize per connection). Responses carry the
+        request id, so a late answer to a timed-out request is dropped
+        instead of satisfying the next one."""
+        with self._req_lock:
+            ev = threading.Event()
+            with self._state_lock:
+                self._req_counter += 1
+                rid = self._req_counter
+                self._pending_id = rid
+                self._pending_ev = ev
+                self._response = None
+            if not self.send(KIND_REQUEST, protocol, payload, req_id=rid):
+                return None
+            ok = ev.wait(timeout)
+            with self._state_lock:
+                self._pending_id = None
+                self._pending_ev = None
+                return self._response if ok else None
+
+    # -- receiving -------------------------------------------------------
+
+    def _read_exact(self, n: int) -> Optional[bytes]:
+        buf = b""
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                hdr = self._read_exact(_HDR.size)
+                if hdr is None:
+                    break
+                total, kind, name_len, req_id = _HDR.unpack(hdr)
+                if total > MAX_FRAME or name_len > total:
+                    break
+                body = self._read_exact(total - 1 - 2 - 4)
+                if body is None:
+                    break
+                name = body[:name_len]
+                try:
+                    payload = snappy.decompress_raw(body[name_len:])
+                except snappy.SnappyError:
+                    continue
+                if kind == KIND_RESPONSE:
+                    with self._state_lock:
+                        if req_id == self._pending_id and self._pending_ev:
+                            self._response = payload
+                            self._pending_ev.set()
+                        # else: stale response for a timed-out request — drop
+                else:
+                    self._on_frame(self, kind, name, payload, req_id)
+        except OSError:
+            pass
+        finally:
+            self.close()
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        self._on_close(self)
+
+
+class Transport:
+    """Listener + peer set. ``on_gossip(peer, topic, payload)``,
+    ``on_request(peer, protocol, payload) -> bytes`` (the response)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.on_gossip: Callable = lambda *a: None
+        self.on_request: Callable = lambda *a: b""
+        self.on_peer_connected: Callable = lambda peer: None
+        self._server = socket.create_server((host, port))
+        self.host = host
+        self.port = self._server.getsockname()[1]
+        self.peers: list[Peer] = []
+        self._lock = threading.Lock()
+        self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._running = True
+        self._accept_thread.start()
+
+    # -- peer management -------------------------------------------------
+
+    def dial(self, host: str, port: int) -> Optional[Peer]:
+        with self._lock:
+            for p in self.peers:
+                if p.remote_listen_port == port and p.addr[0] == host:
+                    return p
+        try:
+            sock = socket.create_connection((host, port), timeout=5)
+        except OSError:
+            return None
+        peer = self._add_peer(sock, (host, port))
+        peer.remote_listen_port = port
+        self.on_peer_connected(peer)
+        return peer
+
+    def _accept_loop(self) -> None:
+        while self._running:
+            try:
+                sock, addr = self._server.accept()
+            except OSError:
+                return
+            peer = self._add_peer(sock, addr)
+            self.on_peer_connected(peer)
+
+    def _add_peer(self, sock: socket.socket, addr) -> Peer:
+        peer = Peer(sock, addr, self._dispatch, self._remove_peer)
+        with self._lock:
+            self.peers.append(peer)
+        return peer
+
+    def _remove_peer(self, peer: Peer) -> None:
+        with self._lock:
+            if peer in self.peers:
+                self.peers.remove(peer)
+
+    def peer_count(self) -> int:
+        with self._lock:
+            return len(self.peers)
+
+    # -- dispatch --------------------------------------------------------
+
+    def _dispatch(self, peer: Peer, kind: int, name: bytes, payload: bytes, req_id: int) -> None:
+        if kind == KIND_GOSSIP:
+            self.on_gossip(peer, name.decode(), payload)
+        elif kind == KIND_REQUEST:
+            try:
+                resp = self.on_request(peer, name.decode(), payload)
+            except Exception:
+                resp = b""
+            peer.send(KIND_RESPONSE, name, resp or b"", req_id=req_id)
+
+    # -- broadcast -------------------------------------------------------
+
+    def publish(self, topic: str, payload: bytes, exclude: Peer | None = None) -> int:
+        n = 0
+        with self._lock:
+            targets = list(self.peers)
+        for p in targets:
+            if p is exclude:
+                continue
+            if p.send(KIND_GOSSIP, topic.encode(), payload):
+                n += 1
+        return n
+
+    def close(self) -> None:
+        self._running = False
+        try:
+            self._server.close()
+        except OSError:
+            pass
+        with self._lock:
+            peers = list(self.peers)
+        for p in peers:
+            p.close()

@@ -1,0 +1,109 @@
+"""Execution layer (engine API vs a mock EL server) + eth1 deposit
+tracking (deposit tree, proofs, block-production inclusion).
+
+Reference analogues: ``execution_layer/src/test_utils`` mock-driven
+tests and ``beacon_node/eth1/tests``.
+"""
+
+import pytest
+
+from lighthouse_tpu.eth1 import Eth1Service, MockEth1Endpoint
+from lighthouse_tpu.eth1.service import DepositTree
+from lighthouse_tpu.execution_layer import (
+    EngineApiClient,
+    ExecutionLayer,
+    MockExecutionLayer,
+)
+from lighthouse_tpu.fork_choice.proto_array import ExecutionStatus
+from lighthouse_tpu.ssz import hash_tree_root
+from lighthouse_tpu.state_transition.merkle import is_valid_merkle_branch
+from lighthouse_tpu.types.chain_spec import minimal_spec
+from lighthouse_tpu.types.containers import types_for
+from lighthouse_tpu.types.preset import MINIMAL
+
+
+@pytest.fixture
+def mock_el():
+    el = MockExecutionLayer()
+    yield el
+    el.stop()
+
+
+def test_engine_api_roundtrip(mock_el):
+    el = ExecutionLayer(EngineApiClient(mock_el.url, jwt_secret=b"s" * 32))
+    assert el.upcheck()
+    status = el.notify_new_payload({"blockHash": "0x" + "11" * 32})
+    assert status == ExecutionStatus.VALID
+    pid = el.notify_forkchoice_updated(b"\x22" * 32, b"\x00" * 32, {"timestamp": "0x0"})
+    assert pid == "0x0000000000000001"
+    payload = el.get_payload(pid)
+    assert payload["blockNumber"] == "0x0"
+    # auth header was sent
+    assert mock_el.requests
+
+
+def test_engine_invalid_and_offline(mock_el):
+    el = ExecutionLayer(EngineApiClient(mock_el.url))
+    mock_el.payload_status = "INVALID"
+    assert el.notify_new_payload({"blockHash": "0x" + "11" * 32}) == ExecutionStatus.INVALID
+    mock_el.payload_status = "SYNCING"
+    assert el.notify_new_payload({"blockHash": "0x" + "11" * 32}) == ExecutionStatus.OPTIMISTIC
+    # dead EL -> optimistic, goes offline
+    dead = ExecutionLayer(EngineApiClient("http://127.0.0.1:1"))
+    assert dead.notify_new_payload({}) == ExecutionStatus.OPTIMISTIC
+    assert not dead.upcheck()
+
+
+def test_deposit_tree_proofs():
+    t = types_for(MINIMAL)
+    tree = DepositTree()
+    datas = []
+    for i in range(5):
+        dd = t.DepositData(pubkey=bytes([i]) * 48, amount=32 * 10**9)
+        datas.append(dd)
+        tree.push(hash_tree_root(dd))
+    root = tree.root()
+    for i, dd in enumerate(datas):
+        proof = tree.proof(i)
+        assert len(proof) == 33  # depth 32 + length mixin
+        assert is_valid_merkle_branch(
+            hash_tree_root(dd), proof, 33, i, root
+        ), f"proof {i} invalid"
+
+
+def test_eth1_service_feeds_block_production():
+    endpoint = MockEth1Endpoint()
+    for i in range(3):
+        endpoint.add_deposit(
+            pubkey=bytes([i]) * 48,
+            withdrawal_credentials=bytes(32),
+            amount=32 * 10**9,
+            signature=bytes(96),
+            block_number=10 + i,
+        )
+    endpoint.seal_block(20, timestamp=1000)
+    svc = Eth1Service(endpoint, MINIMAL, minimal_spec())
+    svc.update()
+
+    t = types_for(MINIMAL)
+    state = t.state["phase0"]()
+    vote = svc.eth1_data_vote(state)
+    assert vote.deposit_count == 3
+    state.eth1_data = vote
+    state.eth1_deposit_index = 0
+    # two MORE deposits arrive after the vote: proofs must still verify
+    # against the voted (count=3) root
+    for j in (90, 91):
+        endpoint.add_deposit(
+            pubkey=bytes([j]) * 48, withdrawal_credentials=bytes(32),
+            amount=32 * 10**9, signature=bytes(96), block_number=j,
+        )
+    svc.update()
+    deposits = svc.deposits_for_block(state, max_count=16)
+    assert len(deposits) == 3
+    # proofs verify against the vote's deposit root
+    for i, dep in enumerate(deposits):
+        assert is_valid_merkle_branch(
+            hash_tree_root(t.DepositData, dep.data),
+            list(dep.proof), 33, i, bytes(vote.deposit_root),
+        )
