@@ -17,6 +17,7 @@ from .block_verification import (
     SignatureVerifiedBlock,
 )
 from .chain import BeaconChain, ShufflingCache, SnapshotCache
+from .fork_revert import revert_to_fork_boundary
 from .observed import (
     ObservedAggregates,
     ObservedAggregators,
@@ -30,6 +31,7 @@ __all__ = [
     "AttestationError",
     "BeaconChain",
     "BlockError",
+    "revert_to_fork_boundary",
     "ExecutionPendingBlock",
     "GossipVerifiedBlock",
     "ObservedAggregates",

@@ -397,7 +397,12 @@ class BeaconChain:
         if fork in ("altair", "bellatrix") and "sync_aggregate" not in body_kwargs:
             from ..crypto.bls import INFINITY_SIGNATURE
 
-            body_kwargs["sync_aggregate"] = t.SyncAggregate(
+            agg = None
+            if self.op_pool is not None and slot >= 1:
+                agg = self.op_pool.sync_aggregate_for_block(
+                    slot - 1, self.head_block_root
+                )
+            body_kwargs["sync_aggregate"] = agg or t.SyncAggregate(
                 sync_committee_signature=INFINITY_SIGNATURE
             )
         body = t.block_body[fork](**body_kwargs)

@@ -86,6 +86,26 @@ class ClientBuilder:
         self.slot_clock = clock
         return self
 
+    def with_checkpoint_sync(self, remote_url: str):
+        """Bootstrap from a remote BN's finalized state instead of genesis
+        (reference checkpoint sync, ``client/src/builder.rs:128-350``);
+        history below the anchor is backfilled by the network layer."""
+        from .eth2_client import BeaconNodeClient
+
+        from .state_transition.helpers import latest_block_header_root
+
+        remote = BeaconNodeClient(remote_url, self.types)
+        state = remote.state_ssz("finalized")
+        self.genesis_state = state
+        # fetch the block by the root the STATE implies — "finalized" could
+        # have advanced between the two requests
+        anchor_root = latest_block_header_root(state)
+        try:
+            self._checkpoint_block = remote.block("0x" + anchor_root.hex())
+        except Exception:
+            self._checkpoint_block = None  # anchor block lookups 404 until synced
+        return self
+
     def build(self) -> Client:
         cfg = self.config
 
@@ -134,6 +154,13 @@ class ClientBuilder:
             self.preset, self.spec, self.types, store, genesis, slot_clock=clock
         )
         chain.op_pool = OperationPool(self.preset, self.spec, self.types)
+        # checkpoint sync: store the anchor block so lookups resolve and
+        # backfill has a starting parent
+        cp_block = getattr(self, "_checkpoint_block", None)
+        if cp_block is not None:
+            from .ssz import hash_tree_root as _htr
+
+            store.put_block(_htr(cp_block.message), cp_block)
 
         processor = _build_processor(chain, cfg.n_workers)
         api = (

@@ -91,6 +91,12 @@ class BeaconNodeClient:
             f"/eth/v1/beacon/states/{state_id}/validators", params
         )["data"]
 
+    def state_ssz(self, state_id: str = "finalized"):
+        """Fork byte + SSZ state (checkpoint-sync bootstrap)."""
+        raw = self._get(f"/eth/v2/debug/beacon/states/{state_id}")
+        fork = {0: "phase0", 1: "altair", 2: "bellatrix"}[raw[0]]
+        return self.t.state[fork].decode(bytes(raw[1:]))
+
     def block(self, block_id: str = "head"):
         out = self._get(f"/eth/v2/beacon/blocks/{block_id}")
         return from_json(self.t.signed_block[out["version"]], out["data"])
@@ -157,6 +163,16 @@ class BeaconNodeClient:
             },
         )
         return from_json(self.t.Attestation, out["data"])
+
+    def sync_duties(self, epoch: int, validator_indices) -> dict:
+        return self._post(
+            f"/eth/v1/validator/duties/sync/{epoch}",
+            [str(i) for i in validator_indices],
+        )
+
+    def publish_sync_committee_messages(self, messages) -> None:
+        """messages: [{slot, beacon_block_root, validator_index, signature}]"""
+        self._post("/eth/v1/beacon/pool/sync_committees", messages)
 
     def publish_aggregate_and_proofs(self, signed_aggregates) -> None:
         self._post(

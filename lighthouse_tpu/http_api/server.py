@@ -48,6 +48,8 @@ from ..state_transition import (
 from ..state_transition.epoch import fork_of
 from ..utils import metrics
 
+_FORK_IDS = {"phase0": 0, "altair": 1, "bellatrix": 2}
+
 
 class ApiError(Exception):
     def __init__(self, status: int, message: str):
@@ -257,6 +259,13 @@ class BeaconApiServer:
                 )
             return {"data": out}
 
+        m = re.fullmatch(r"/eth/v2/debug/beacon/states/([^/]+)", path)
+        if m:
+            # SSZ bytes (checkpoint-sync serving, reference http_api
+            # debug routes + SURVEY §5 checkpoint sync)
+            st = self._state_for(m.group(1))
+            return bytes([_FORK_IDS[fork_of(st)]]) + type(st).encode(st)
+
         m = re.fullmatch(r"/eth/v1/beacon/headers/([^/]+)", path)
         if m:
             root, block = self._block_for(m.group(1))
@@ -328,6 +337,88 @@ class BeaconApiServer:
             if chain.op_pool is not None:
                 chain.op_pool.insert_proposer_slashing(s)
             return None
+
+        if path == "/eth/v1/beacon/pool/sync_committees" and method == "POST":
+            st = chain.head_state
+            if not hasattr(st, "current_sync_committee"):
+                raise ApiError(400, "pre-altair state has no sync committee")
+            from ..crypto import bls as _bls
+            from ..types.chain_spec import DOMAIN_SYNC_COMMITTEE
+            from ..types.domains import compute_signing_root, get_domain
+
+            rejected = 0
+            for obj in body:
+                vi = int(obj["validator_index"])
+                slot = int(obj["slot"])
+                committee = _sync_committee_for_slot(chain, st, slot)
+                if committee is None:
+                    rejected += 1
+                    continue
+                pk_raw = bytes(st.validators[vi].pubkey)
+                positions = [i for i, c in enumerate(committee) if c == pk_raw]
+                if not positions:
+                    rejected += 1
+                    continue
+                root = bytes.fromhex(obj["beacon_block_root"][2:])
+                sig_raw = bytes.fromhex(obj["signature"][2:])
+                # verify BEFORE pooling: a junk signature must never be
+                # able to poison block production
+                domain = get_domain(
+                    chain.spec, st, DOMAIN_SYNC_COMMITTEE,
+                    slot // chain.preset.SLOTS_PER_EPOCH,
+                )
+                signing_root = compute_signing_root(None, root, domain)
+                try:
+                    sig = _bls.Signature.deserialize(sig_raw)
+                    pk = chain.pubkey_cache.get(vi)
+                    ok = sig.verify(pk, signing_root)
+                except (_bls.BlsError, Exception):
+                    ok = False
+                if not ok:
+                    rejected += 1
+                    continue
+                for pos in positions:
+                    chain.op_pool.insert_sync_committee_message(
+                        slot, root, pos, sig_raw
+                    )
+            if rejected:
+                raise ApiError(400, f"{rejected} sync message(s) rejected")
+            return None
+
+        m = re.fullmatch(r"/eth/v1/validator/duties/sync/(\d+)", path)
+        if m and method == "POST":
+            st = chain.head_state
+            if not hasattr(st, "current_sync_committee"):
+                return {"data": []}
+            epoch = int(m.group(1))
+            committee_b = _sync_committee_for_epoch(chain, st, epoch)
+            if committee_b is None:
+                raise ApiError(
+                    400, "epoch outside current/next sync-committee period"
+                )
+            wanted = {int(i) for i in (body or [])}
+            committee = committee_b
+            by_pk = {}
+            for i, v in enumerate(st.validators):
+                by_pk[bytes(v.pubkey)] = i
+            duties = []
+            seen = {}
+            for pos, pk in enumerate(committee):
+                vi = by_pk.get(pk)
+                if vi is None or (wanted and vi not in wanted):
+                    continue
+                seen.setdefault(vi, []).append(pos)
+            for vi, positions in seen.items():
+                duties.append(
+                    {
+                        "pubkey": "0x" + bytes(st.validators[vi].pubkey).hex(),
+                        "validator_index": str(vi),
+                        "validator_sync_committee_indices": [
+                            str(p) for p in positions
+                        ],
+                    }
+                )
+            return {"data": duties}
 
         m = re.fullmatch(r"/eth/v1/validator/duties/proposer/(\d+)", path)
         if m:
@@ -429,6 +520,28 @@ class BeaconApiServer:
             return None
 
         raise ApiError(404, f"no route for {method} {path}")
+
+
+def _sync_committee_for_epoch(chain, state, epoch: int):
+    """Pubkey list for the sync-committee period containing ``epoch``:
+    current period -> current committee, next period -> next committee,
+    anything else -> None (the state cannot know it)."""
+    P = chain.preset
+    period = epoch // P.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+    head_period = (
+        state.slot // P.SLOTS_PER_EPOCH
+    ) // P.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+    if period == head_period:
+        return [bytes(pk) for pk in state.current_sync_committee.pubkeys]
+    if period == head_period + 1:
+        return [bytes(pk) for pk in state.next_sync_committee.pubkeys]
+    return None
+
+
+def _sync_committee_for_slot(chain, state, slot: int):
+    return _sync_committee_for_epoch(
+        chain, state, slot // chain.preset.SLOTS_PER_EPOCH
+    )
 
 
 def _validator_status(P, state, v) -> str:

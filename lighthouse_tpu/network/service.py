@@ -101,6 +101,7 @@ class NetworkService:
         self._seen: dict[bytes, float] = {}  # gossip message-id dedup
         self._seen_lock = threading.Lock()
         self.sync = RangeSync(self)
+        self.backfill = BackfillSync(self)
 
     @property
     def topics(self) -> Topics:
@@ -323,6 +324,122 @@ class NetworkService:
                     out.append(struct.pack("<I", len(enc)) + enc)
             return b"".join(out)
         return b""
+
+
+class BackfillSync:
+    """Reverse sync below a checkpoint anchor (reference
+    ``network/src/sync/backfill_sync``): pull descending batches with
+    blocks_by_range, check hash-linkage to the known anchor chain, batch
+    proposal-signature verification with per-epoch fork domains (correct
+    across any number of fork boundaries), then store."""
+
+    BATCH = 32
+
+    def __init__(self, service: NetworkService):
+        self.service = service
+        self.complete = False
+
+    def _proposal_set(self, chain, anchor_state, sb, block_root):
+        """Proposal signature set with the domain computed from the
+        block's OWN epoch's fork version (get_domain on a state only
+        knows one fork back; historical blocks need the schedule)."""
+        from ..crypto import bls
+        from ..types.chain_spec import DOMAIN_BEACON_PROPOSER
+        from ..types.domains import compute_domain, compute_signing_root
+
+        epoch = sb.message.slot // chain.preset.SLOTS_PER_EPOCH
+        domain = compute_domain(
+            chain.spec,
+            DOMAIN_BEACON_PROPOSER,
+            chain.spec.fork_version_at_epoch(epoch),
+            bytes(anchor_state.genesis_validators_root),
+        )
+        root = compute_signing_root(None, block_root, domain)
+        pk = chain.pubkey_cache.get(sb.message.proposer_index)
+        return bls.SignatureSet.single_pubkey(
+            bls.Signature.deserialize(bytes(sb.signature)), pk, root
+        )
+
+    def run(self, peer: Peer) -> int:
+        """Blocking backfill from the oldest stored block downwards.
+        Returns the number of blocks stored."""
+        from ..crypto import bls
+        from ..store.iter import block_roots_iter
+
+        chain = self.service.chain
+        stored = 0
+        oldest_root = None
+        oldest_slot = None
+        for slot, root in block_roots_iter(chain.store, chain.head_block_root):
+            oldest_root, oldest_slot = root, slot
+        if oldest_root is None or oldest_slot == 0:
+            self.complete = True
+            return 0
+        block = chain.store.get_block(oldest_root)
+        want = bytes(block.message.parent_root)
+        anchor_state = chain.head_state
+        next_below = oldest_slot  # request strictly below this slot
+        while want != bytes(32):
+            start = max(0, next_below - self.BATCH)
+            count = next_below - start
+            if count <= 0:
+                break
+            raw = peer.request(
+                PROTO_BLOCKS_BY_RANGE.encode(),
+                struct.pack("<QQ", start, count),
+                timeout=30,
+            )
+            if not raw:
+                return stored
+            blocks = self._decode_blocks_any_fork(raw)
+            if not blocks:
+                return stored
+            # walk the batch backwards, checking hash linkage to `want`
+            verified = []
+            sets = []
+            for sb in reversed(blocks):
+                root = hash_tree_root(sb.message)
+                if root != want:
+                    continue  # forked/extra block in response
+                if sb.message.slot > 0:
+                    sets.append(
+                        self._proposal_set(chain, anchor_state, sb, root)
+                    )
+                verified.append((root, sb))
+                want = bytes(sb.message.parent_root)
+            if not verified:
+                return stored
+            if sets and not bls.verify_signature_sets(sets):
+                return stored
+            for root, sb in verified:
+                chain.store.put_block(root, sb)
+                stored += 1
+            next_below = verified[-1][1].message.slot
+            if verified[-1][1].message.slot == 0:
+                break
+        self.complete = True
+        return stored
+
+    def _decode_blocks_any_fork(self, raw: bytes) -> list:
+        """Length-prefixed blocks; each tried against every scheduled
+        fork's type (historical batches span fork boundaries)."""
+        t = self.service.chain.types
+        out = []
+        i = 0
+        while i + 4 <= len(raw):
+            (n,) = struct.unpack_from("<I", raw, i)
+            i += 4
+            if i + n > len(raw):
+                break
+            chunk = raw[i:i + n]
+            i += n
+            for fork in ("bellatrix", "altair", "phase0"):
+                try:
+                    out.append(t.signed_block[fork].decode(chunk))
+                    break
+                except Exception:
+                    continue
+        return out
 
 
 class RangeSync:

@@ -52,6 +52,8 @@ class OperationPool:
         self._proposer_slashings: dict[int, object] = {}
         self._attester_slashings: list[object] = []
         self._voluntary_exits: dict[int, object] = {}
+        # (slot, block_root) -> {committee_position: signature}
+        self._sync_messages: dict[tuple[int, bytes], dict[int, bytes]] = {}
 
     # -- attestations ----------------------------------------------------
 
@@ -172,6 +174,42 @@ class OperationPool:
             and is_slashable_validator(state.validators[int(i)], epoch)
         }
 
+    # -- sync committee messages (altair+) -------------------------------
+    # (reference: beacon_chain's naive_sync_aggregation_pool + op pool
+    # sync contributions)
+
+    def insert_sync_committee_message(self, slot: int, block_root: bytes,
+                                      committee_position: int, signature: bytes) -> None:
+        with self._lock:
+            key = (slot, bytes(block_root))
+            self._sync_messages.setdefault(key, {})[committee_position] = bytes(signature)
+
+    def sync_aggregate_for_block(self, slot: int, block_root: bytes):
+        """Best-effort SyncAggregate over collected messages for
+        (slot, root); None when empty (caller uses the empty aggregate)."""
+        with self._lock:
+            msgs = self._sync_messages.get((slot, bytes(block_root)))
+            if not msgs:
+                return None
+            items = sorted(msgs.items())
+        agg = bls.AggregateSignature.infinity()
+        positions = []
+        for pos, raw in items:
+            try:
+                agg.add_assign(bls.Signature.deserialize(raw))
+            except bls.BlsError:
+                continue  # undecodable signature: skip, never break production
+            positions.append(pos)
+        if not positions:
+            return None
+        size = self.preset.SYNC_COMMITTEE_SIZE
+        pos_set = set(positions)
+        bits = [p in pos_set for p in range(size)]
+        return self.types.SyncAggregate(
+            sync_committee_bits=bits,
+            sync_committee_signature=agg.serialize(),
+        )
+
     def packing_for_block(self, chain, state) -> dict:
         """Everything the block body takes from the pool (reference
         ``produce_block_on_state`` op-pool calls)."""
@@ -244,4 +282,9 @@ class OperationPool:
                 v: s
                 for v, s in self._proposer_slashings.items()
                 if is_slashable_validator(state.validators[v], current)
+            }
+            self._sync_messages = {
+                k: v
+                for k, v in self._sync_messages.items()
+                if k[0] + 2 >= state.slot  # only slot-1 is ever packed
             }

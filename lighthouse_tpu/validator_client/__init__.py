@@ -13,6 +13,7 @@ from .services import (
     DoppelgangerService,
     DutiesService,
     ProposerDuty,
+    SyncCommitteeService,
     ValidatorClient,
 )
 from .validator_store import InitializedValidator, ValidatorStore
@@ -26,6 +27,7 @@ __all__ = [
     "DutiesService",
     "InitializedValidator",
     "ProposerDuty",
+    "SyncCommitteeService",
     "ValidatorClient",
     "ValidatorStore",
 ]

@@ -1,0 +1,111 @@
+"""Keymanager API (reference: ``validator_client/src/http_api`` — the
+standardized key-manager routes with bearer-token auth):
+
+    GET    /eth/v1/keystores          list local keys
+    POST   /eth/v1/keystores          import keystores (+passwords)
+    DELETE /eth/v1/keystores          delete keys (+ slashing data export)
+"""
+
+from __future__ import annotations
+
+import json
+import secrets
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class KeymanagerApi:
+    def __init__(self, store, host: str = "127.0.0.1", port: int = 0,
+                 token: str | None = None):
+        self.store = store
+        self.token = token or secrets.token_hex(16)
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _auth(self) -> bool:
+                return (
+                    self.headers.get("Authorization", "")
+                    == f"Bearer {outer.token}"
+                )
+
+            def _reply(self, code: int, obj) -> None:
+                payload = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):
+                if not self._auth():
+                    return self._reply(403, {"message": "invalid token"})
+                if self.path == "/eth/v1/keystores":
+                    data = [
+                        {
+                            "validating_pubkey": "0x" + pk.hex(),
+                            "derivation_path": "",
+                            "readonly": False,
+                        }
+                        for pk in outer.store.pubkeys()
+                    ]
+                    return self._reply(200, {"data": data})
+                self._reply(404, {"message": "not found"})
+
+            def do_POST(self):
+                if not self._auth():
+                    return self._reply(403, {"message": "invalid token"})
+                n = int(self.headers.get("Content-Length") or 0)
+                body = json.loads(self.rfile.read(n)) if n else {}
+                if self.path == "/eth/v1/keystores":
+                    out = []
+                    for ks_raw, pw in zip(
+                        body.get("keystores", []), body.get("passwords", [])
+                    ):
+                        try:
+                            ks = (
+                                json.loads(ks_raw)
+                                if isinstance(ks_raw, str)
+                                else ks_raw
+                            )
+                            outer.store.add_keystore(ks, pw)
+                            out.append({"status": "imported"})
+                        except Exception as e:
+                            out.append({"status": "error", "message": str(e)})
+                    return self._reply(200, {"data": out})
+                self._reply(404, {"message": "not found"})
+
+            def do_DELETE(self):
+                if not self._auth():
+                    return self._reply(403, {"message": "invalid token"})
+                n = int(self.headers.get("Content-Length") or 0)
+                body = json.loads(self.rfile.read(n)) if n else {}
+                if self.path == "/eth/v1/keystores":
+                    out = []
+                    for pk_hex in body.get("pubkeys", []):
+                        pk = bytes.fromhex(pk_hex[2:])
+                        ok = outer.store.remove(pk)
+                        out.append({"status": "deleted" if ok else "not_found"})
+                    # EIP-3076 slashing data rides along, per the keymanager spec
+                    return self._reply(
+                        200,
+                        {
+                            "data": out,
+                            "slashing_protection": outer.store.slashing_db.export_json(),
+                        },
+                    )
+                self._reply(404, {"message": "not found"})
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_address[1]
+        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()

@@ -215,6 +215,70 @@ class AttestationService:
         return published
 
 
+class SyncCommitteeService:
+    """Sync-committee message production per slot (reference
+    ``sync_committee_service.rs``): every duty signs the head block root
+    and publishes; contribution aggregation happens node-side via the
+    sync-message pool."""
+
+    def __init__(self, store, nodes: BeaconNodeFallback, preset):
+        self.store = store
+        self.nodes = nodes
+        self.preset = preset
+        self.duties: dict[int, list[dict]] = {}  # epoch -> duty dicts
+
+    def poll_epoch(self, epoch: int) -> None:
+        own = [
+            self.store.index_of(pk)
+            for pk in self.store.pubkeys()
+            if self.store.index_of(pk) is not None
+        ]
+        if not own:
+            self.duties[epoch] = []
+            return
+        try:
+            out = self.nodes.call("sync_duties", epoch, sorted(own))
+            self.duties[epoch] = out.get("data", [])
+        except BeaconNodeError:
+            _FAILED_DUTIES.inc()
+            return  # transient: retry next slot instead of caching empty
+        for e in [e for e in self.duties if e + 2 < epoch]:
+            del self.duties[e]
+
+    def sign_and_publish(self, slot: int) -> int:
+        epoch = slot // self.preset.SLOTS_PER_EPOCH
+        if epoch not in self.duties:
+            self.poll_epoch(epoch)
+        duties = self.duties.get(epoch, [])
+        if not duties:
+            return 0
+        published = 0
+        try:
+            head = self.nodes.call("header", "head")
+            root = bytes.fromhex(head["root"][2:])
+            msgs = []
+            for d in duties:
+                pk = bytes.fromhex(d["pubkey"][2:])
+                try:
+                    sig = self.store.sign_sync_committee_message(pk, slot, root)
+                except KeyError:
+                    continue
+                msgs.append(
+                    {
+                        "slot": str(slot),
+                        "beacon_block_root": "0x" + root.hex(),
+                        "validator_index": d["validator_index"],
+                        "signature": "0x" + sig.hex(),
+                    }
+                )
+            if msgs:
+                self.nodes.call("publish_sync_committee_messages", msgs)
+                published = len(msgs)
+        except BeaconNodeError:
+            _FAILED_DUTIES.inc()
+        return published
+
+
 class BlockService:
     """Proposal flow: randao -> produce -> sign -> publish (reference
     ``block_service.rs``)."""
@@ -294,6 +358,7 @@ class ValidatorClient:
         self.duties = DutiesService(store, nodes, preset)
         self.attestations = AttestationService(store, nodes, self.duties, types)
         self.blocks = BlockService(store, nodes, self.duties, preset)
+        self.sync_committee = SyncCommitteeService(store, nodes, preset)
         self._stop = threading.Event()
 
     def on_slot(self, slot: int) -> None:
@@ -312,6 +377,7 @@ class ValidatorClient:
         self.blocks.propose(slot)
         self.attestations.attest(slot)
         self.attestations.aggregate(slot)
+        self.sync_committee.sign_and_publish(slot)
 
     def run_forever(self) -> None:
         while not self._stop.is_set():

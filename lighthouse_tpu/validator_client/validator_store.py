@@ -18,6 +18,7 @@ from ..types.chain_spec import (
     DOMAIN_BEACON_PROPOSER,
     DOMAIN_RANDAO,
     DOMAIN_SELECTION_PROOF,
+    DOMAIN_SYNC_COMMITTEE,
     DOMAIN_VOLUNTARY_EXIT,
 )
 from ..types.domains import compute_domain, compute_signing_root
@@ -142,6 +143,14 @@ class ValidatorStore:
             message=aggregate_and_proof,
             signature=self._sk(pubkey).sign(root).serialize(),
         )
+
+    def sign_sync_committee_message(
+        self, pubkey: bytes, slot: int, block_root: bytes
+    ) -> bytes:
+        epoch = slot // self.preset.SLOTS_PER_EPOCH
+        domain = self._domain(DOMAIN_SYNC_COMMITTEE, epoch)
+        root = compute_signing_root(None, bytes(block_root), domain)
+        return self._sk(pubkey).sign(root).serialize()
 
     def sign_voluntary_exit(self, pubkey: bytes, exit_msg):
         domain = self._domain(DOMAIN_VOLUNTARY_EXIT, exit_msg.epoch)

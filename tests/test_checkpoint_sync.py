@@ -1,0 +1,98 @@
+"""Checkpoint sync (bootstrap from a remote finalized state) + backfill
++ fork_revert.
+
+Reference analogues: ``client/src/builder.rs:128-350`` checkpoint-sync
+bootstrap, ``network/src/sync/backfill_sync``, ``fork_revert.rs``.
+"""
+
+import time
+
+import pytest
+
+from lighthouse_tpu.beacon_chain import revert_to_fork_boundary
+from lighthouse_tpu.client import ClientBuilder, ClientConfig
+from lighthouse_tpu.crypto import backend
+from lighthouse_tpu.testing.simulator import LocalNetwork
+
+
+@pytest.fixture(autouse=True)
+def fake_backend():
+    backend.set_backend("fake")
+    yield
+    backend.set_backend("cpu")
+
+
+def test_checkpoint_sync_bootstrap_and_backfill():
+    """A source node finalizes; a fresh node bootstraps from its
+    finalized state over HTTP and backfills history over RPC."""
+    from lighthouse_tpu.http_api import BeaconApiServer
+
+    net = LocalNetwork(1, validator_count=8)
+    api = BeaconApiServer(net.nodes[0].chain, port=0).start()
+    try:
+        P = net.h.preset
+        for _ in range(4 * P.SLOTS_PER_EPOCH):
+            net.tick_slot(attest=True)
+        src = net.nodes[0]
+        fin_epoch = src.chain.fork_choice.store.finalized_checkpoint[0]
+        assert fin_epoch >= 1
+
+        cfg = ClientConfig(preset_base="minimal", http_enabled=False, bls_backend="fake")
+        from lighthouse_tpu.types.chain_spec import minimal_spec
+
+        builder = ClientBuilder(cfg, minimal_spec()).with_checkpoint_sync(
+            f"http://127.0.0.1:{api.port}"
+        )
+        client = builder.build()
+        try:
+            anchor_slot = client.chain.head_state.slot
+            assert anchor_slot >= fin_epoch * P.SLOTS_PER_EPOCH
+            # the anchor is NOT genesis: the chain starts mid-history
+            assert client.chain.head_state.slot > 0
+
+            # backfill history below the anchor over RPC
+            from lighthouse_tpu.network import NetworkService
+
+            net_svc = NetworkService(client.chain, client.processor)
+            try:
+                peer = net_svc.connect("127.0.0.1", src.net.port)
+                assert peer is not None
+                stored = net_svc.backfill.run(peer)
+                assert net_svc.backfill.complete
+                assert stored > 0
+                # the full ancestor chain is now stored down to slot 0/1
+                from lighthouse_tpu.store.iter import block_roots_iter
+
+                slots = [
+                    s
+                    for s, _ in block_roots_iter(
+                        client.chain.store, client.chain.head_block_root
+                    )
+                ]
+                assert min(slots) <= 1
+            finally:
+                net_svc.close()
+        finally:
+            client.processor.shutdown()
+    finally:
+        api.stop()
+        net.close()
+
+
+def test_fork_revert():
+    net = LocalNetwork(1, validator_count=8)
+    try:
+        P = net.h.preset
+        for _ in range(2 * P.SLOTS_PER_EPOCH):
+            net.tick_slot(attest=False)
+        chain = net.nodes[0].chain
+        head_before = chain.head_state.slot
+        assert head_before == 2 * P.SLOTS_PER_EPOCH
+        # pretend epoch 1 was a missed fork: revert to the last block
+        # before it
+        root = revert_to_fork_boundary(chain, fork_epoch=1)
+        assert chain.head_state.slot < P.SLOTS_PER_EPOCH
+        assert chain.head_block_root == root
+        assert chain.fork_choice.proto.contains(root)
+    finally:
+        net.close()
