@@ -64,6 +64,31 @@ def build_parser() -> argparse.ArgumentParser:
     v.add_argument("--out-dir", required=True)
     v.add_argument("--count", type=int, default=1)
 
+    bnode = sub.add_parser("boot-node", help="standalone peer-exchange bootstrap server")
+    bnode.add_argument("--port", type=int, default=9000)
+
+    lcli = sub.add_parser("lcli", help="dev/ops tools (reference lcli)")
+    lcli_sub = lcli.add_subparsers(dest="lcli_command", required=True)
+    ss = lcli_sub.add_parser("skip-slots", help="advance a state N slots")
+    ss.add_argument("--preset", choices=["mainnet", "minimal"], default="minimal")
+    ss.add_argument("--state", required=True, help="SSZ state file (fork byte prefixed)")
+    ss.add_argument("--slots", type=int, required=True)
+    ss.add_argument("--out", required=True)
+    pr = lcli_sub.add_parser("pretty-ssz", help="decode an SSZ object to JSON")
+    pr.add_argument("--preset", choices=["mainnet", "minimal"], default="mainnet")
+    pr.add_argument("--type", required=True, dest="type_name")
+    pr.add_argument("--file", required=True)
+    ig = lcli_sub.add_parser("interop-genesis", help="write an interop genesis state")
+    ig.add_argument("--preset", choices=["mainnet", "minimal"], default="minimal")
+    ig.add_argument("--validators", type=int, default=64)
+    ig.add_argument("--genesis-time", type=int, default=0)
+    ig.add_argument("--out", required=True)
+    tb = lcli_sub.add_parser("transition-blocks", help="apply SSZ blocks to a state")
+    tb.add_argument("--preset", choices=["mainnet", "minimal"], default="minimal")
+    tb.add_argument("--state", required=True)
+    tb.add_argument("--blocks", nargs="+", required=True)
+    tb.add_argument("--out", required=True)
+
     db = sub.add_parser("db", help="database manager")
     _add_global_flags(db)
     db_sub = db.add_subparsers(dest="db_command", required=True)
@@ -175,6 +200,113 @@ def run_am(args) -> int:
     return 1
 
 
+def run_boot_node(args) -> int:
+    """Chain-less peer-exchange hub (reference ``boot_node``: a
+    standalone discv5 server; here the transport's peer-exchange protocol
+    plays the discovery role)."""
+    import json as _json
+    import threading as _threading
+
+    from .network.service import PROTO_PEER_EXCHANGE, PROTO_PING, PROTO_STATUS
+    from .network.transport import Transport
+
+    t = Transport(port=args.port)
+
+    def on_request(peer, protocol, payload):
+        if protocol == PROTO_PEER_EXCHANGE:
+            peers = [
+                [p.addr[0], p.remote_listen_port]
+                for p in t.peers
+                if p.remote_listen_port
+            ]
+            return _json.dumps(peers).encode()
+        if protocol == PROTO_STATUS:
+            try:
+                theirs = _json.loads(payload)
+                peer.remote_listen_port = theirs.get("listen_port")
+            except ValueError:
+                pass
+            return _json.dumps({"boot_node": True, "head_slot": 0}).encode()
+        if protocol == PROTO_PING:
+            return b"pong"
+        return b""
+
+    t.on_request = on_request
+    print(f"boot node up on port {t.port}", flush=True)
+    stop = _threading.Event()
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    stop.wait()
+    t.close()
+    return 0
+
+
+def run_lcli(args) -> int:
+    from .ssz.json import to_json
+    from .state_transition import interop_genesis_state, per_slot_processing, process_block
+    from .state_transition.epoch import fork_of
+    from .types.chain_spec import mainnet_spec, minimal_spec
+    from .types.containers import types_for
+    from .types.preset import PRESETS
+
+    preset = PRESETS[args.preset]
+    spec = minimal_spec() if args.preset == "minimal" else mainnet_spec()
+    from .types.containers import FORK_IDS as ids, FORK_NAMES as forks
+
+    t = types_for(preset)
+
+    def read_state(path):
+        raw = open(path, "rb").read()
+        return t.state[forks[raw[0]]].decode(raw[1:])
+
+    def write_state(path, st):
+        with open(path, "wb") as f:
+            f.write(bytes([ids[fork_of(st)]]) + type(st).encode(st))
+
+    if args.lcli_command == "interop-genesis":
+        st = interop_genesis_state(
+            preset, spec, args.validators, genesis_time=args.genesis_time
+        )
+        write_state(args.out, st)
+        print(f"wrote genesis state ({len(st.validators)} validators) to {args.out}")
+        return 0
+    if args.lcli_command == "skip-slots":
+        st = read_state(args.state)
+        for _ in range(args.slots):
+            st = per_slot_processing(preset, spec, st)
+        write_state(args.out, st)
+        print(f"advanced to slot {st.slot}")
+        return 0
+    if args.lcli_command == "transition-blocks":
+        st = read_state(args.state)
+        import struct as _struct
+
+        for path in args.blocks:
+            raw = open(path, "rb").read()
+            # fork of a block follows ITS slot (may be past a fork
+            # boundary the state has not crossed yet): slot is the first
+            # u64 of the message, at fixed offset 4 (signature offset) + 0
+            slot = _struct.unpack_from("<Q", raw, 4)[0]
+            fork = spec.fork_name_at_epoch(slot // preset.SLOTS_PER_EPOCH)
+            sb = t.signed_block[fork].decode(raw)
+            while st.slot < sb.message.slot:
+                st = per_slot_processing(preset, spec, st)
+            process_block(preset, spec, st, sb, fork_of(st), signature_strategy="none")
+        write_state(args.out, st)
+        print(f"applied {len(args.blocks)} block(s); state at slot {st.slot}")
+        return 0
+    if args.lcli_command == "pretty-ssz":
+        raw = open(args.file, "rb").read()
+        tpe = getattr(t, args.type_name, None)
+        if tpe is None:
+            print(f"unknown type {args.type_name}", file=sys.stderr)
+            return 1
+        obj = tpe.decode(raw)
+        print(json.dumps(to_json(tpe, obj), indent=2))
+        return 0
+    return 1
+
+
 def run_db(args) -> int:
     from .store import Column, SqliteStore
 
@@ -206,6 +338,10 @@ def main(argv=None) -> int:
         return run_am(args)
     if args.command == "db":
         return run_db(args)
+    if args.command == "boot-node":
+        return run_boot_node(args)
+    if args.command == "lcli":
+        return run_lcli(args)
     return 1
 
 

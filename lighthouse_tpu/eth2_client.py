@@ -93,8 +93,10 @@ class BeaconNodeClient:
 
     def state_ssz(self, state_id: str = "finalized"):
         """Fork byte + SSZ state (checkpoint-sync bootstrap)."""
+        from .types.containers import FORK_NAMES
+
         raw = self._get(f"/eth/v2/debug/beacon/states/{state_id}")
-        fork = {0: "phase0", 1: "altair", 2: "bellatrix"}[raw[0]]
+        fork = FORK_NAMES[raw[0]]
         return self.t.state[fork].decode(bytes(raw[1:]))
 
     def block(self, block_id: str = "head"):

@@ -19,9 +19,12 @@ consume):
     POST /eth/v1/beacon/pool/voluntary_exits
     POST /eth/v1/beacon/pool/attester_slashings
     POST /eth/v1/beacon/pool/proposer_slashings
+    POST /eth/v1/beacon/pool/sync_committees
+    GET  /eth/v2/debug/beacon/states/{state_id}  (SSZ, checkpoint sync)
     GET  /eth/v1/config/spec
     GET  /eth/v1/validator/duties/proposer/{epoch}
     POST /eth/v1/validator/duties/attester/{epoch}
+    POST /eth/v1/validator/duties/sync/{epoch}
     GET  /eth/v2/validator/blocks/{slot}
     GET  /eth/v1/validator/attestation_data
     GET  /eth/v1/validator/aggregate_attestation
@@ -46,9 +49,8 @@ from ..state_transition import (
     partial_state_advance,
 )
 from ..state_transition.epoch import fork_of
+from ..types.containers import FORK_IDS as _FORK_IDS
 from ..utils import metrics
-
-_FORK_IDS = {"phase0": 0, "altair": 1, "bellatrix": 2}
 
 
 class ApiError(Exception):

@@ -27,10 +27,8 @@ from typing import Callable, Iterator, Optional
 
 from ..ssz import hash_tree_root
 from ..state_transition.epoch import fork_of
+from ..types.containers import FORK_IDS as _FORK_IDS, FORK_NAMES as _FORK_NAMES
 from .kv import Column, KeyValueStore
-
-_FORK_IDS = {"phase0": 0, "altair": 1, "bellatrix": 2}
-_FORK_NAMES = {v: k for k, v in _FORK_IDS.items()}
 
 _SPLIT_KEY = b"split"
 _HEAD_KEY = b"head"

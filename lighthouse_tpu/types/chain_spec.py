@@ -124,6 +124,28 @@ def mainnet_spec() -> ChainSpec:
     return ChainSpec()
 
 
+def gnosis_spec() -> ChainSpec:
+    """Gnosis chain (reference GnosisEthSpec + gnosis network config):
+    mainnet preset values with 5-second slots and its own fork schedule."""
+    return ChainSpec(
+        config_name="gnosis",
+        preset_base="mainnet",
+        seconds_per_slot=5,
+        genesis_fork_version=bytes([0, 0, 0, 0x64]),
+        altair_fork_version=bytes([1, 0, 0, 0x64]),
+        altair_fork_epoch=512,
+        bellatrix_fork_version=bytes([2, 0, 0, 0x64]),
+        bellatrix_fork_epoch=385536,
+        min_genesis_time=1638968400,
+        min_genesis_active_validator_count=4096,
+        churn_limit_quotient=4096,
+        deposit_chain_id=100,
+        deposit_network_id=100,
+        seconds_per_eth1_block=6,
+        eth1_follow_distance=1024,
+    )
+
+
 def minimal_spec(**overrides) -> ChainSpec:
     """Minimal-preset test spec (forks at genesis unless overridden)."""
     base = ChainSpec(

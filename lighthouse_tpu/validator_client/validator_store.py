@@ -26,10 +26,12 @@ from ..types.domains import compute_domain, compute_signing_root
 
 @dataclass
 class InitializedValidator:
-    """A loaded, enabled validator (reference initialized_validators.rs)."""
+    """A loaded, enabled validator (reference initialized_validators.rs +
+    ``signing_method.rs:78-89``: LocalKeystore vs Web3Signer)."""
 
-    secret_key: bls.SecretKey
     pubkey: bytes
+    secret_key: Optional[bls.SecretKey] = None  # LocalKeystore
+    remote_signer: Optional[object] = None      # Web3Signer client
     index: Optional[int] = None  # validator index once known on-chain
     enabled: bool = True
 
@@ -58,9 +60,20 @@ class ValidatorStore:
     def add_secret_key(self, sk: bls.SecretKey) -> bytes:
         pk = sk.public_key().serialize()
         with self._lock:
-            self._validators[pk] = InitializedValidator(sk, pk)
+            self._validators[pk] = InitializedValidator(pk, secret_key=sk)
         self.slashing_db.register_validator(pk)
         return pk
+
+    def add_remote_key(self, pubkey: bytes, signer) -> bytes:
+        """Web3Signer-style remote signing (reference
+        ``signing_method.rs`` Web3Signer variant): the private key never
+        enters this process."""
+        with self._lock:
+            self._validators[bytes(pubkey)] = InitializedValidator(
+                bytes(pubkey), remote_signer=signer
+            )
+        self.slashing_db.register_validator(bytes(pubkey))
+        return bytes(pubkey)
 
     def add_keystore(self, keystore: dict, password: str) -> bytes:
         sk_bytes = decrypt(keystore, password)
@@ -86,12 +99,15 @@ class ValidatorStore:
             v = self._validators.get(pubkey)
             return v.index if v else None
 
-    def _sk(self, pubkey: bytes) -> bls.SecretKey:
+    def _sign(self, pubkey: bytes, signing_root: bytes) -> bytes:
+        """Signature bytes via the validator's signing method."""
         with self._lock:
             v = self._validators.get(pubkey)
         if v is None or not v.enabled:
             raise KeyError(f"unknown/disabled validator {pubkey.hex()[:12]}")
-        return v.secret_key
+        if v.secret_key is not None:
+            return v.secret_key.sign(signing_root).serialize()
+        return v.remote_signer.sign(pubkey, signing_root)
 
     # -- domains ---------------------------------------------------------
 
@@ -110,9 +126,9 @@ class ValidatorStore:
         self.slashing_db.check_and_insert_block_proposal(
             pubkey, block.slot, root
         )
-        sig = self._sk(pubkey).sign(root)
+        sig = self._sign(pubkey, root)
         fork = self.spec.fork_name_at_epoch(epoch)
-        return self.t.signed_block[fork](message=block, signature=sig.serialize())
+        return self.t.signed_block[fork](message=block, signature=sig)
 
     def sign_attestation(self, pubkey: bytes, data):
         domain = self._domain(DOMAIN_BEACON_ATTESTER, data.target.epoch)
@@ -120,18 +136,18 @@ class ValidatorStore:
         self.slashing_db.check_and_insert_attestation(
             pubkey, data.source.epoch, data.target.epoch, root
         )
-        return self._sk(pubkey).sign(root).serialize()
+        return self._sign(pubkey, root)
 
     def randao_reveal(self, pubkey: bytes, epoch: int) -> bytes:
         domain = self._domain(DOMAIN_RANDAO, epoch)
         root = compute_signing_root(Uint64, epoch, domain)
-        return self._sk(pubkey).sign(root).serialize()
+        return self._sign(pubkey, root)
 
     def selection_proof(self, pubkey: bytes, slot: int) -> bytes:
         epoch = slot // self.preset.SLOTS_PER_EPOCH
         domain = self._domain(DOMAIN_SELECTION_PROOF, epoch)
         root = compute_signing_root(Uint64, slot, domain)
-        return self._sk(pubkey).sign(root).serialize()
+        return self._sign(pubkey, root)
 
     def sign_aggregate_and_proof(self, pubkey: bytes, aggregate_and_proof):
         epoch = aggregate_and_proof.aggregate.data.target.epoch
@@ -141,7 +157,7 @@ class ValidatorStore:
         )
         return self.t.SignedAggregateAndProof(
             message=aggregate_and_proof,
-            signature=self._sk(pubkey).sign(root).serialize(),
+            signature=self._sign(pubkey, root),
         )
 
     def sign_sync_committee_message(
@@ -150,11 +166,11 @@ class ValidatorStore:
         epoch = slot // self.preset.SLOTS_PER_EPOCH
         domain = self._domain(DOMAIN_SYNC_COMMITTEE, epoch)
         root = compute_signing_root(None, bytes(block_root), domain)
-        return self._sk(pubkey).sign(root).serialize()
+        return self._sign(pubkey, root)
 
     def sign_voluntary_exit(self, pubkey: bytes, exit_msg):
         domain = self._domain(DOMAIN_VOLUNTARY_EXIT, exit_msg.epoch)
         root = compute_signing_root(type(exit_msg), exit_msg, domain)
         return self.t.SignedVoluntaryExit(
-            message=exit_msg, signature=self._sk(pubkey).sign(root).serialize()
+            message=exit_msg, signature=self._sign(pubkey, root)
         )
