@@ -1,0 +1,150 @@
+"""Host-pipeline benchmarks (BASELINE.md configs #3 measurement shape and
+the cached-state-root criterion from VERDICT r1 #9).
+
+1. Gossip pipeline: N single-bit attestations submitted to the
+   BeaconProcessor, coalesced into device-bucket batches, structurally
+   verified and applied to fork choice (fake BLS backend isolates the
+   HOST pipeline cost — the device cost is bench.py's job). Reports
+   throughput and queue-wait p50/p99 from the processor's histograms.
+2. State re-hash: full hash_tree_root vs the incremental cached root on a
+   large validator registry after a small per-slot mutation.
+
+Run: python benches/bench_pipeline.py [n_attestations] [n_validators]
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+
+def bench_gossip_pipeline(n_atts: int) -> dict:
+    from lighthouse_tpu.beacon_chain import (
+        BeaconChain,
+        VerifiedUnaggregatedAttestation,
+    )
+    from lighthouse_tpu.beacon_processor import BeaconProcessor, Work, WorkKind
+    from lighthouse_tpu.crypto import backend
+    from lighthouse_tpu.state_transition import store_replayer
+    from lighthouse_tpu.store import HotColdDB, MemoryStore
+    from lighthouse_tpu.testing.harness import StateHarness
+    from lighthouse_tpu.types.chain_spec import minimal_spec
+    from lighthouse_tpu.types.preset import MINIMAL
+    from lighthouse_tpu.utils import metrics
+    from lighthouse_tpu.utils.slot_clock import ManualSlotClock
+
+    backend.set_backend("fake")
+    h = StateHarness(
+        MINIMAL, minimal_spec(), validator_count=64, fork_name="phase0",
+        fake_sign=True,
+    )
+    genesis = copy.deepcopy(h.state)
+    db = HotColdDB(MemoryStore(), h.t, h.spec, store_replayer(h.preset, h.spec))
+    clock = ManualSlotClock(genesis.genesis_time, h.spec.seconds_per_slot)
+    chain = BeaconChain(h.preset, h.spec, h.t, db, genesis, slot_clock=clock)
+    slot = 1
+    clock.set_slot(slot)
+    sb = h.produce_block(slot)
+    h.process_block(sb, strategy="none")
+    chain.process_block(chain.verify_block_for_gossip(sb))
+    clock.set_slot(slot + 1)
+
+    # template attestations across committees; duplicates of distinct
+    # validators via committee positions
+    templates = h.attestations_for_slot(h.state, slot)
+    singles = []
+    while len(singles) < n_atts:
+        for att in templates:
+            bits = list(att.aggregation_bits)
+            for i in range(len(bits)):
+                single = copy.deepcopy(att)
+                single.aggregation_bits = [j == i for j in range(len(bits))]
+                singles.append(single)
+                if len(singles) >= n_atts:
+                    break
+            if len(singles) >= n_atts:
+                break
+
+    done = []
+
+    def on_batch(items):
+        res = chain.batch_verify_unaggregated_attestations_for_gossip(items)
+        for r in res:
+            if isinstance(r, VerifiedUnaggregatedAttestation):
+                chain.apply_attestation_to_fork_choice(r)
+        return res
+
+    bp = BeaconProcessor({WorkKind.GOSSIP_ATTESTATION: on_batch}, n_workers=2)
+    t0 = time.perf_counter()
+    accepted = 0
+    shed = 0
+    for s in singles:
+        if bp.submit(Work(WorkKind.GOSSIP_ATTESTATION, s, done=done.append)):
+            accepted += 1
+        else:
+            shed += 1  # bounded-queue shedding: those done-callbacks never fire
+    while len(done) < accepted and time.perf_counter() - t0 < 120:
+        time.sleep(0.005)
+    dt = time.perf_counter() - t0
+    bp.shutdown()
+
+    wait = metrics.histogram("beacon_processor_queue_wait_seconds")
+    batch = metrics.histogram("beacon_processor_batch_size")
+    return {
+        "n": len(done),
+        "shed": shed,
+        "throughput_per_sec": round(len(done) / dt, 1),
+        "queue_wait_p50_s": wait.quantile(0.5),
+        "queue_wait_p99_s": wait.quantile(0.99),
+        "mean_batch": round(batch.sum / max(1, batch.total), 1),
+    }
+
+
+def bench_state_rehash(n_validators: int) -> dict:
+    from lighthouse_tpu.ssz import hash_tree_root
+    from lighthouse_tpu.ssz.cache import CachedRootComputer
+    from lighthouse_tpu.types.containers import types_for
+    from lighthouse_tpu.types.preset import MAINNET
+
+    t = types_for(MAINNET)
+    state = t.state["phase0"]()
+    v0 = t.Validator(pubkey=b"\xaa" * 48, effective_balance=32 * 10**9)
+    state.validators = [copy.copy(v0) for _ in range(n_validators)]
+    state.balances = [32 * 10**9] * n_validators
+    for i, v in enumerate(state.validators):
+        v.withdrawal_credentials = i.to_bytes(32, "little")
+
+    comp = CachedRootComputer()
+    t0 = time.perf_counter()
+    r_full = hash_tree_root(state)
+    t_full = time.perf_counter() - t0
+    comp.hash_tree_root(state)  # warm the cache
+    # per-slot-shaped mutation: a few balances + one validator + slot
+    state.balances[7] += 1
+    state.balances[1234 % n_validators] += 1
+    state.validators[42 % n_validators].effective_balance += 1
+    state.slot += 1
+    t0 = time.perf_counter()
+    r_inc = comp.hash_tree_root(state)
+    t_inc = time.perf_counter() - t0
+    assert r_inc == hash_tree_root(state)
+    return {
+        "n_validators": n_validators,
+        "full_s": round(t_full, 3),
+        "incremental_s": round(t_inc, 4),
+        "speedup": round(t_full / t_inc, 1),
+    }
+
+
+if __name__ == "__main__":
+    n_atts = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+    n_vals = int(sys.argv[2]) if len(sys.argv) > 2 else 100_000
+    out = {
+        "gossip_pipeline": bench_gossip_pipeline(n_atts),
+        "state_rehash": bench_state_rehash(n_vals),
+    }
+    print(json.dumps(out, indent=2))

@@ -1,0 +1,9 @@
+#!/bin/sh
+# Compile-bound device suites, one PROCESS per file: XLA:CPU has crashed
+# (faulthandler SIGSEGV) after accumulating many multi-minute compiles in
+# a single process; isolation keeps each file's compiles bounded.
+set -e
+for f in tests/test_device_curve.py tests/test_device_pairing.py tests/test_device_bls.py; do
+  echo "=== $f ==="
+  python -m pytest "$f" -q -m slow -p no:cacheprovider
+done

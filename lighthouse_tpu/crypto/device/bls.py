@@ -8,14 +8,14 @@ re-designed as one fixed-shape, branch-free JAX program:
       agg_pk_i = sum of the set's pubkeys          (masked Jacobian sum)
       sig subgroup check: psi(sig) == [x] sig      (64-bit scan)
       r_i agg_pk_i, r_i sig_i                      (64-bit random scalars)
-    sig_acc = sum_i r_i sig_i                      (log-depth tree)
+    sig_acc = sum_i r_i sig_i                      (scan reduction)
     ok = FE( prod_i ML(r_i agg_pk_i, H(m_i)) * ML(-g1, sig_acc) ) == 1
          AND all subgroup checks
 
 The batch dimension is the data-parallel axis the reference spreads over
 rayon cores (``block_signature_verifier.rs:374-382``); here it is the
 device batch axis, shardable over chips via ``jax.sharding`` (see
-``parallel/``).
+``__graft_entry__.dryrun_multichip`` for the dp x tp mesh layout).
 
 Shapes (B sets, K max pubkeys/set):
   pk_xy  int32[B, K, 2, 32]   pk_mask bool[B, K]

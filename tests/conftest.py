@@ -27,8 +27,10 @@ jax.config.update("jax_platforms", "cpu")
 # NOTE: the JAX persistent compilation cache is deliberately NOT enabled:
 # on this host XLA:CPU AOT cache entries round-trip with mismatched machine
 # features (+prefer-no-scatter/+prefer-no-gather) and intermittently
-# SIGSEGV on load (observed in the pairing scan). Fresh compiles are cheap
-# enough after the batched-tower rewrite (~15-25s for the largest graphs).
+# SIGSEGV on load (observed in the pairing scan). The compile-bound device
+# programs (full pairing / BLS / curve suites) are gated behind the
+# ``slow`` marker instead (see pytest.ini); the default suite only
+# compiles the small fp/fp2/htc graphs.
 
 import random  # noqa: E402
 

@@ -3,6 +3,10 @@
 import numpy as np
 import pytest
 
+# Each test compiles a multi-second XLA program; gated like the pairing
+# suites so the default run stays under the 5-minute budget.
+pytestmark = pytest.mark.slow
+
 from lighthouse_tpu.crypto.cpu.curve import (
     G1Point,
     G2Point,
