@@ -28,6 +28,7 @@ import subprocess
 import sys
 import time
 
+# Full geometry (TPU): one gossip aggregate batch, reference mix.
 N_AGG = 64
 COMMITTEE = 16
 N_MSGS = 8
@@ -35,20 +36,75 @@ B_PAD = 256
 K_PAD = 16
 M_PAD = 8
 TARGET_AGG_PER_SEC = 50_000.0
-PROBE_TIMEOUT_S = 240
+INIT_TIMEOUT_S = 60      # backend init (a dead tunnel hangs forever)
+PROBE_TIMEOUT_S = 420    # full warm-up compile budget
+
+
+def _shrink_for_cpu_fallback() -> None:
+    """The CPU fallback exists to ALWAYS print a measurement, not to be
+    fast — shrink the workload so host-oracle setup + the XLA:CPU compile
+    + runs fit a tight driver budget. Throughput extrapolates."""
+    global N_AGG, COMMITTEE, N_MSGS, B_PAD, K_PAD, M_PAD
+    N_AGG = 16
+    COMMITTEE = 8
+    N_MSGS = 4
+    B_PAD = 64
+    K_PAD = 8
+    M_PAD = 4
 
 
 def probe_tpu() -> bool:
-    """Can the TPU backend initialize at all? Run in a subprocess so a
-    hung tunnel cannot wedge the bench itself."""
-    code = "import jax; assert jax.devices()[0].platform != 'cpu'"
+    """Is the TPU backend usable within budget? The probe runs in a
+    SUBPROCESS (a hung tunnel cannot wedge the bench) and performs the
+    full warm-up compile of the bench program at the bench bucket shapes
+    with the persistent compile cache enabled — if it completes, the main
+    process's compile is either cached or proven feasible; if it times
+    out or dies, the bench falls back to CPU and still prints a number."""
+    # stage 1: can the backend initialize at all? (fast fail on a dead
+    # relay — jax.devices() otherwise blocks indefinitely)
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; assert jax.devices()[0].platform != 'cpu'"],
+            timeout=INIT_TIMEOUT_S,
+            capture_output=True,
+        )
+        if r.returncode != 0:
+            return False
+    except subprocess.TimeoutExpired:
+        return False
+
+    cache_dir = os.path.join(os.path.dirname(__file__) or ".", ".jax_cache")
+    code = f"""
+import jax
+assert jax.devices()[0].platform != "cpu"
+try:
+    jax.config.update("jax_compilation_cache_dir", {cache_dir!r})
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+except Exception:
+    pass
+import numpy as np, jax.numpy as jnp
+from lighthouse_tpu.crypto.device import fp
+from lighthouse_tpu.crypto.device.bls import verify_batch_hashed_fn
+args = (
+    jnp.zeros(({B_PAD}, {K_PAD}, 2, fp.NL), jnp.int32),
+    jnp.zeros(({B_PAD}, {K_PAD}), bool),
+    jnp.zeros(({B_PAD}, 2, 2, fp.NL), jnp.int32),
+    jnp.zeros(({M_PAD}, 2, 2, fp.NL), jnp.int32),
+    jnp.zeros(({B_PAD},), jnp.int32),
+    jnp.zeros(({B_PAD}, 2), jnp.int32),
+    jnp.zeros(({B_PAD},), bool),
+)
+jax.jit(verify_batch_hashed_fn).lower(*args).compile()
+print("COMPILE_OK")
+"""
     try:
         r = subprocess.run(
             [sys.executable, "-c", code],
             timeout=PROBE_TIMEOUT_S,
             capture_output=True,
         )
-        return r.returncode == 0
+        return r.returncode == 0 and b"COMPILE_OK" in r.stdout
     except subprocess.TimeoutExpired:
         return False
 
@@ -79,10 +135,17 @@ def build_sets():
 
 
 def main() -> None:
-    if not probe_tpu():
+    use_cpu = not probe_tpu()
+    if use_cpu:
         os.environ["JAX_PLATFORMS"] = "cpu"
+        _shrink_for_cpu_fallback()
 
     import jax
+
+    if use_cpu:
+        # The env var alone does NOT stop the axon plugin from initializing
+        # (and hanging on a dead tunnel); the config knob does.
+        jax.config.update("jax_platforms", "cpu")
 
     try:
         cache_dir = os.path.join(os.path.dirname(__file__) or ".", ".jax_cache")

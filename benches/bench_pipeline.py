@@ -16,10 +16,11 @@ from __future__ import annotations
 
 import copy
 import json
+import os
 import sys
 import time
 
-sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def bench_gossip_pipeline(n_atts: int) -> dict:

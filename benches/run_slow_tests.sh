@@ -1,9 +1,30 @@
 #!/bin/sh
-# Compile-bound device suites, one PROCESS per file: XLA:CPU has crashed
-# (faulthandler SIGSEGV) after accumulating many multi-minute compiles in
-# a single process; isolation keeps each file's compiles bounded.
-set -e
+# Compile-bound device suites, one PROCESS PER TEST: XLA:CPU on this host
+# segfaults after accumulating several multi-minute scan-heavy compiles in
+# a single process (observed in per-file runs too), so each test gets a
+# fresh process. Slow (~1 compile per test) but deterministic.
+fail=0
+total=0
 for f in tests/test_device_curve.py tests/test_device_pairing.py tests/test_device_bls.py; do
   echo "=== $f ==="
-  python -m pytest "$f" -q -m slow -p no:cacheprovider
+  ids=$(python -m pytest "$f" -m slow --collect-only -q -p no:cacheprovider 2>/tmp/slow_collect.err | grep "::")
+  if [ -z "$ids" ]; then
+    echo "COLLECTION FAILED for $f:"
+    tail -5 /tmp/slow_collect.err
+    fail=1
+    continue
+  fi
+  for t in $ids; do
+    total=$((total + 1))
+    if python -m pytest "$t" -q -m slow -p no:cacheprovider > /tmp/slow_one.log 2>&1; then
+      echo "PASS $t"
+    else
+      echo "FAIL $t"
+      tail -5 /tmp/slow_one.log
+      fail=1
+    fi
+  done
 done
+echo "ran $total tests, fail=$fail"
+[ "$total" -gt 0 ] || fail=1
+exit $fail

@@ -104,6 +104,16 @@ class BeaconApiServer:
             n = int(req.headers.get("Content-Length") or 0)
             raw = req.rfile.read(n) if n else b""
             body = json.loads(raw) if raw else None
+        if url.path == "/eth/v1/events":
+            if method != "GET":
+                payload = json.dumps({"code": 405, "message": "GET only"}).encode()
+                req.send_response(405)
+                req.send_header("Content-Type", "application/json")
+                req.send_header("Content-Length", str(len(payload)))
+                req.end_headers()
+                req.wfile.write(payload)
+                return
+            return self._stream_events(req, query)
         try:
             out = self._route(method, url.path, query, body)
             if out is None:
@@ -138,6 +148,80 @@ class BeaconApiServer:
                 req.wfile.write(payload)
             except Exception:
                 pass
+
+    def _stream_events(self, req, query) -> None:
+        """Server-sent events (reference http_api ``events`` route):
+        ``head`` and ``finalized_checkpoint`` topics, polled off the
+        chain's canonical head; streams until the client disconnects
+        (periodic keepalive comments bound disconnect detection and stop
+        dead-connection threads accumulating)."""
+        import time as _time
+
+        topics = set((query.get("topics") or "head").split(","))
+        chain = self.chain
+        req.send_response(200)
+        req.send_header("Content-Type", "text/event-stream")
+        req.send_header("Cache-Control", "no-cache")
+        req.end_headers()
+        last_head = None
+        last_epoch = None
+        last_fin = None
+        last_write = _time.monotonic()
+        try:
+            while True:
+                head = chain.head_block_root
+                if "head" in topics and head != last_head:
+                    # consistent (root, state) snapshot: recompute_head
+                    # writes the two fields non-atomically, so re-check
+                    # the root after reading the state
+                    for _ in range(5):
+                        state = chain.head_state
+                        if chain.head_block_root == head:
+                            break
+                        head = chain.head_block_root
+                    last_head = head
+                    # state root is free from the stored head block
+                    block = chain.store.get_block(head)
+                    state_root = (
+                        bytes(block.message.state_root)
+                        if block is not None
+                        else hash_tree_root(state)
+                    )
+                    epoch = state.slot // chain.preset.SLOTS_PER_EPOCH
+                    data = {
+                        "slot": str(state.slot),
+                        "block": "0x" + head.hex(),
+                        "state": "0x" + state_root.hex(),
+                        "epoch_transition": (
+                            last_epoch is not None and epoch != last_epoch
+                        ),
+                    }
+                    last_epoch = epoch
+                    req.wfile.write(
+                        b"event: head\ndata: " + json.dumps(data).encode() + b"\n\n"
+                    )
+                    req.wfile.flush()
+                    last_write = _time.monotonic()
+                fin = chain.fork_choice.store.finalized_checkpoint
+                if "finalized_checkpoint" in topics and fin != last_fin:
+                    last_fin = fin
+                    data = {
+                        "epoch": str(fin[0]),
+                        "block": "0x" + fin[1].hex(),
+                    }
+                    req.wfile.write(
+                        b"event: finalized_checkpoint\ndata: "
+                        + json.dumps(data).encode() + b"\n\n"
+                    )
+                    req.wfile.flush()
+                    last_write = _time.monotonic()
+                if _time.monotonic() - last_write > 5.0:
+                    req.wfile.write(b":keepalive\n\n")
+                    req.wfile.flush()
+                    last_write = _time.monotonic()
+                _time.sleep(0.2)
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            return
 
     # -- state/block resolution ------------------------------------------
 
@@ -214,6 +298,7 @@ class BeaconApiServer:
             return {"data": chain.spec.to_api_dict(chain.preset)}
         if path == "/metrics":
             return metrics.gather()
+
 
         m = re.fullmatch(r"/eth/v1/beacon/states/([^/]+)/root", path)
         if m:

@@ -138,3 +138,36 @@ def test_slashing_protection_stops_double_proposal(node):
 
     with pytest.raises(SlashingProtectionError):
         store.sign_block(pk, block2)
+
+
+def test_sse_events_stream(node):
+    """/eth/v1/events streams head + finalized events as blocks land."""
+    import threading
+    import urllib.request
+
+    h, chain, clock, server = node
+    events = []
+
+    def reader():
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/eth/v1/events?topics=head,finalized_checkpoint"
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            for _ in range(4):  # event: + data: + blank, twice
+                line = r.readline().decode().strip()
+                if line:
+                    events.append(line)
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    import time
+
+    time.sleep(0.5)  # reader receives the initial head event
+    slot = h.state.slot + 1
+    clock.set_slot(slot)
+    sb = h.produce_block(slot)
+    h.process_block(sb, strategy="none")
+    chain.process_block(chain.verify_block_for_gossip(sb))
+    t.join(timeout=10)
+    assert any(e == "event: head" for e in events), events
+    assert any(e.startswith("data:") and '"block"' in e for e in events), events
