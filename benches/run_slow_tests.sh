@@ -7,10 +7,11 @@ fail=0
 total=0
 for f in tests/test_device_curve.py tests/test_device_pairing.py tests/test_device_bls.py; do
   echo "=== $f ==="
-  ids=$(python -m pytest "$f" -m slow --collect-only -q -p no:cacheprovider 2>/tmp/slow_collect.err | grep "::")
+  python -m pytest "$f" -m slow --collect-only -q -p no:cacheprovider > /tmp/slow_collect.log 2>&1
+  ids=$(grep "::" /tmp/slow_collect.log)
   if [ -z "$ids" ]; then
     echo "COLLECTION FAILED for $f:"
-    tail -5 /tmp/slow_collect.err
+    tail -8 /tmp/slow_collect.log
     fail=1
     continue
   fi

@@ -104,17 +104,11 @@ class BeaconApiServer:
             n = int(req.headers.get("Content-Length") or 0)
             raw = req.rfile.read(n) if n else b""
             body = json.loads(raw) if raw else None
-        if url.path == "/eth/v1/events":
-            if method != "GET":
-                payload = json.dumps({"code": 405, "message": "GET only"}).encode()
-                req.send_response(405)
-                req.send_header("Content-Type", "application/json")
-                req.send_header("Content-Length", str(len(payload)))
-                req.end_headers()
-                req.wfile.write(payload)
-                return
-            return self._stream_events(req, query)
         try:
+            if url.path == "/eth/v1/events":
+                if method != "GET":
+                    raise ApiError(405, "GET only")
+                return self._stream_events(req, query)
             out = self._route(method, url.path, query, body)
             if out is None:
                 payload, ctype = b"", "application/json"
@@ -165,31 +159,29 @@ class BeaconApiServer:
         req.end_headers()
         last_head = None
         last_epoch = None
-        last_fin = None
+        # a new subscriber must NOT get a synthetic event for a
+        # finalization that happened long ago
+        last_fin = chain.fork_choice.store.finalized_checkpoint
         last_write = _time.monotonic()
         try:
             while True:
                 head = chain.head_block_root
                 if "head" in topics and head != last_head:
-                    # consistent (root, state) snapshot: recompute_head
-                    # writes the two fields non-atomically, so re-check
-                    # the root after reading the state
-                    for _ in range(5):
-                        state = chain.head_state
-                        if chain.head_block_root == head:
-                            break
-                        head = chain.head_block_root
                     last_head = head
-                    # state root is free from the stored head block
+                    # derive slot + state root from the STORED block:
+                    # immune to the non-atomic head_block_root/head_state
+                    # update in recompute_head
                     block = chain.store.get_block(head)
-                    state_root = (
-                        bytes(block.message.state_root)
-                        if block is not None
-                        else hash_tree_root(state)
-                    )
-                    epoch = state.slot // chain.preset.SLOTS_PER_EPOCH
+                    if block is not None:
+                        slot = block.message.slot
+                        state_root = bytes(block.message.state_root)
+                    else:  # anchor edge: fall back to the state
+                        state = chain.head_state
+                        slot = state.slot
+                        state_root = hash_tree_root(state)
+                    epoch = slot // chain.preset.SLOTS_PER_EPOCH
                     data = {
-                        "slot": str(state.slot),
+                        "slot": str(slot),
                         "block": "0x" + head.hex(),
                         "state": "0x" + state_root.hex(),
                         "epoch_transition": (

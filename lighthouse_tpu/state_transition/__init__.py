@@ -21,7 +21,7 @@ from .replay import replay_blocks, store_replayer
 from .mutators import initiate_validator_exit, slash_validator
 from .shuffle import compute_shuffled_index, shuffle_list, unshuffle_list
 from .signature_sets import BlockSignatureAccumulator
-from .slot import partial_state_advance, per_slot_processing, process_slot
+from .slot import partial_state_advance, per_slot_processing, process_slot, state_transition
 from .upgrade import maybe_upgrade_state, upgrade_to_altair, upgrade_to_bellatrix
 from .helpers import (
     CommitteeCache,

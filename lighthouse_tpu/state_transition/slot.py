@@ -33,6 +33,34 @@ def per_slot_processing(preset: Preset, spec: ChainSpec, state):
     return maybe_upgrade_state(preset, spec, state)
 
 
+def state_transition(
+    preset: Preset, spec: ChainSpec, state, signed_block,
+    signature_strategy: str = "individual", validate_result: bool = True,
+):
+    """The spec's top-level ``state_transition``: advance slots, apply the
+    block, and (validate_result) require the block's claimed state root to
+    match (reference ``per_block_processing`` callers + spec
+    ``state_transition``). Returns the (possibly fork-upgraded) state."""
+    from .block import BlockProcessingError, process_block
+    from .epoch import fork_of
+
+    block = signed_block.message
+    while state.slot < block.slot:
+        state = per_slot_processing(preset, spec, state)
+    process_block(
+        preset, spec, state, signed_block, fork_of(state),
+        signature_strategy=signature_strategy,
+    )
+    if validate_result:
+        got = cached_state_root(state)
+        if got != bytes(block.state_root):
+            raise BlockProcessingError(
+                f"state root mismatch: block claims "
+                f"{bytes(block.state_root).hex()[:12]}, got {got.hex()[:12]}"
+            )
+    return state
+
+
 def partial_state_advance(preset: Preset, spec: ChainSpec, state, target_slot: int):
     """Advance to ``target_slot`` (reference ``partial_state_advance``:
     used before signature verification of future-slot objects)."""

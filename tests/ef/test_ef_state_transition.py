@@ -22,6 +22,7 @@ from lighthouse_tpu.state_transition import (
     compute_shuffled_index,
     per_slot_processing,
     process_block,
+    state_transition,
 )
 from lighthouse_tpu.state_transition import block as st_block
 from lighthouse_tpu.state_transition import epoch as st_epoch
@@ -64,25 +65,25 @@ def test_shuffling(config):
 
 
 # operation handler -> (input file stem, apply function)
-def _apply_operation(P, spec, state, fork, handler, op, t):
+def _apply_operation(P, spec, state, fork, handler, op, t, verify=True):
     resolver = state_pubkey_resolver(state)
     if handler == "attestation":
-        st_block.process_attestation(P, spec, state, op, fork, True, resolver)
+        st_block.process_attestation(P, spec, state, op, fork, verify, resolver)
     elif handler == "attester_slashing":
-        st_block.process_attester_slashing(P, spec, state, op, fork, True, resolver)
+        st_block.process_attester_slashing(P, spec, state, op, fork, verify, resolver)
     elif handler == "proposer_slashing":
-        st_block.process_proposer_slashing(P, spec, state, op, fork, True, resolver)
+        st_block.process_proposer_slashing(P, spec, state, op, fork, verify, resolver)
     elif handler == "block_header":
         st_block.process_block_header(P, state, op)
     elif handler == "deposit":
         st_block.process_deposit(P, spec, state, op, fork)
     elif handler == "voluntary_exit":
-        st_block.process_voluntary_exit(P, spec, state, op, True, resolver)
+        st_block.process_voluntary_exit(P, spec, state, op, verify, resolver)
     elif handler == "sync_aggregate":
         from lighthouse_tpu.state_transition.block import state_pubkey_bytes_resolver
 
         st_block.process_sync_aggregate(
-            P, spec, state, state.slot, op, True,
+            P, spec, state, state.slot, op, verify,
             state_pubkey_bytes_resolver(state),
         )
     elif handler == "execution_payload":
@@ -120,12 +121,12 @@ def test_operations(config, fork):
             op_path = maybe(case / f"{stem}.ssz_snappy")
             if op_path is None:
                 continue
+            meta = load_meta(case)
+            verify = meta.get("bls_setting", 1) != 2
             tpe = t.block[fork] if type_name is None else getattr(t, type_name)
             op = tpe.decode(load_ssz_snappy(op_path))
-            if type_name is None:
-                op = op  # block_header takes the full block message
             try:
-                _apply_operation(P, spec, pre, fork, handler, op, t)
+                _apply_operation(P, spec, pre, fork, handler, op, t, verify)
                 ok = True
             except (BlockProcessingError, ValueError, IndexError):
                 ok = False
@@ -183,11 +184,10 @@ def test_sanity_blocks(config, fork):
                 sb = t.signed_block[fork].decode(
                     load_ssz_snappy(case / f"blocks_{i}.ssz_snappy")
                 )
-                while state.slot < sb.message.slot:
-                    state = per_slot_processing(P, spec, state)
-                process_block(
-                    P, spec, state, sb, fork,
+                state = state_transition(
+                    P, spec, state, sb,
                     signature_strategy="individual" if verify else "none",
+                    validate_result=True,
                 )
         except (BlockProcessingError, ValueError, IndexError):
             ok = False

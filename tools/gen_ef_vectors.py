@@ -1,0 +1,194 @@
+"""Generate consensus-spec-tests-LAYOUT vectors from this repo's own
+state transition.
+
+Purpose: the official tarballs cannot be downloaded in this environment
+(no egress), so the ef harness in ``tests/ef`` would otherwise never
+execute. These vectors are SELF-GENERATED — they validate the harness
+machinery (layout discovery, ssz_snappy decoding, handler plumbing,
+pre/post comparison) and serve as regression pins for the state
+transition, NOT as cross-client conformance (that still requires the
+official vectors; see tests/ef/README.md).
+
+Layout written (mirrors the official tarballs):
+
+    <out>/tests/minimal/<fork>/sanity/blocks/pyspec_tests/case_0/...
+    <out>/tests/minimal/<fork>/sanity/slots/pyspec_tests/case_0/...
+    <out>/tests/minimal/<fork>/operations/attestation/pyspec_tests/...
+    <out>/tests/minimal/<fork>/epoch_processing/.../pyspec_tests/...
+    <out>/tests/minimal/<fork>/ssz_static/<Type>/ssz_random/case_0/...
+    <out>/tests/minimal/phase0/shuffling/core/shuffle/shuffle_0/...
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import yaml
+
+from lighthouse_tpu.crypto import backend
+from lighthouse_tpu.ssz import hash_tree_root
+from lighthouse_tpu.state_transition import per_slot_processing
+from lighthouse_tpu.state_transition import block as st_block
+from lighthouse_tpu.state_transition import epoch as st_epoch
+from lighthouse_tpu.state_transition.block import state_pubkey_resolver
+from lighthouse_tpu.testing.harness import StateHarness
+from lighthouse_tpu.types.chain_spec import minimal_spec
+from lighthouse_tpu.types.preset import MINIMAL
+from lighthouse_tpu.utils.snappy import compress_raw
+
+
+def _write(path: str, data: bytes) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(data)
+
+
+def _write_yaml(path: str, obj) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        yaml.safe_dump(obj, f)
+
+
+def _ssz_snappy(tpe, value) -> bytes:
+    return compress_raw(tpe.encode(value))
+
+
+def generate(out_root: str, fork: str = "phase0") -> int:
+    """Returns the number of cases written."""
+    backend.set_backend("fake")
+    base = os.path.join(out_root, "tests", "minimal", fork)
+    h = StateHarness(
+        MINIMAL, minimal_spec(), validator_count=8, fork_name=fork,
+        fake_sign=True,
+    )
+    t = h.t
+    state_t = t.state[fork]
+    n = 0
+
+    # -- sanity/slots ----------------------------------------------------
+    pre = copy.deepcopy(h.state)
+    post = copy.deepcopy(pre)
+    for _ in range(3):
+        post = per_slot_processing(h.preset, h.spec, post)
+    case = os.path.join(base, "sanity", "slots", "pyspec_tests", "slots_3")
+    _write(os.path.join(case, "pre.ssz_snappy"), _ssz_snappy(state_t, pre))
+    _write(os.path.join(case, "post.ssz_snappy"), _ssz_snappy(state_t, post))
+    _write_yaml(os.path.join(case, "slots.yaml"), 3)
+    n += 1
+
+    # -- sanity/blocks (valid chain; bls_setting 2 = signatures ignored) -
+    pre = copy.deepcopy(h.state)
+    blocks = h.extend_chain(2, strategy="none", attest=True)
+    post = copy.deepcopy(h.state)
+    case = os.path.join(base, "sanity", "blocks", "pyspec_tests", "two_blocks")
+    _write(os.path.join(case, "pre.ssz_snappy"), _ssz_snappy(state_t, pre))
+    _write(os.path.join(case, "post.ssz_snappy"), _ssz_snappy(state_t, post))
+    for i, sb in enumerate(blocks):
+        _write(
+            os.path.join(case, f"blocks_{i}.ssz_snappy"),
+            _ssz_snappy(t.signed_block[fork], sb),
+        )
+    _write_yaml(
+        os.path.join(case, "meta.yaml"), {"blocks_count": 2, "bls_setting": 2}
+    )
+    n += 1
+
+    # invalid case: block with a wrong state root -> no post file
+    bad = copy.deepcopy(blocks[0])
+    bad.message.state_root = b"\x13" * 32
+    case = os.path.join(base, "sanity", "blocks", "pyspec_tests", "bad_state_root")
+    _write(os.path.join(case, "pre.ssz_snappy"), _ssz_snappy(state_t, pre))
+    _write(os.path.join(case, "blocks_0.ssz_snappy"), _ssz_snappy(t.signed_block[fork], bad))
+    _write_yaml(
+        os.path.join(case, "meta.yaml"), {"blocks_count": 1, "bls_setting": 2}
+    )
+    n += 1
+
+    # -- operations/attestation ------------------------------------------
+    h2 = StateHarness(
+        MINIMAL, minimal_spec(), validator_count=8, fork_name=fork,
+        fake_sign=True,
+    )
+    h2.extend_chain(2, strategy="none", attest=False)
+    att = h2.attestations_for_slot(h2.state, h2.state.slot - 1)[0]
+    pre = copy.deepcopy(h2.state)
+    post = copy.deepcopy(pre)
+    st_block.process_attestation(
+        h2.preset, h2.spec, post, att, fork, False, state_pubkey_resolver(post)
+    )
+    case = os.path.join(base, "operations", "attestation", "pyspec_tests", "ok")
+    _write(os.path.join(case, "pre.ssz_snappy"), _ssz_snappy(state_t, pre))
+    _write(os.path.join(case, "attestation.ssz_snappy"), _ssz_snappy(t.Attestation, att))
+    _write(os.path.join(case, "post.ssz_snappy"), _ssz_snappy(state_t, post))
+    _write_yaml(os.path.join(case, "meta.yaml"), {"bls_setting": 2})
+    n += 1
+    # invalid: future attestation -> no post
+    early = copy.deepcopy(att)
+    early.data.slot = pre.slot  # violates MIN_ATTESTATION_INCLUSION_DELAY
+    case = os.path.join(base, "operations", "attestation", "pyspec_tests", "too_early")
+    _write(os.path.join(case, "pre.ssz_snappy"), _ssz_snappy(state_t, pre))
+    _write(os.path.join(case, "attestation.ssz_snappy"), _ssz_snappy(t.Attestation, early))
+    _write_yaml(os.path.join(case, "meta.yaml"), {"bls_setting": 2})
+    n += 1
+
+    # -- epoch_processing/effective_balance_updates ----------------------
+    pre = copy.deepcopy(h.state)
+    pre.balances[0] = 17 * 10**9
+    post = copy.deepcopy(pre)
+    st_epoch.process_effective_balance_updates(h.preset, post)
+    case = os.path.join(
+        base, "epoch_processing", "effective_balance_updates",
+        "pyspec_tests", "case_0",
+    )
+    _write(os.path.join(case, "pre.ssz_snappy"), _ssz_snappy(state_t, pre))
+    _write(os.path.join(case, "post.ssz_snappy"), _ssz_snappy(state_t, post))
+    n += 1
+
+    # -- ssz_static -------------------------------------------------------
+    for name, tpe, value in [
+        ("Checkpoint", t.Checkpoint, t.Checkpoint(epoch=9, root=b"\x0b" * 32)),
+        ("AttestationData", t.AttestationData, att.data),
+        ("Validator", t.Validator, h.state.validators[0]),
+        ("BeaconState", state_t, h.state),
+    ]:
+        case = os.path.join(base, "ssz_static", name, "ssz_random", "case_0")
+        _write(os.path.join(case, "serialized.ssz_snappy"), _ssz_snappy(tpe, value))
+        _write_yaml(
+            os.path.join(case, "roots.yaml"),
+            {"root": "0x" + hash_tree_root(tpe, value).hex()},
+        )
+        n += 1
+
+    # -- shuffling (phase0 only in the official layout) ------------------
+    if fork == "phase0":
+        from lighthouse_tpu.state_transition import compute_shuffled_index
+
+        seed = b"\x2a" * 32
+        count = 16
+        mapping = [
+            compute_shuffled_index(i, count, seed, MINIMAL.SHUFFLE_ROUND_COUNT)
+            for i in range(count)
+        ]
+        case = os.path.join(
+            out_root, "tests", "minimal", "phase0", "shuffling", "core",
+            "shuffle", "shuffle_0",
+        )
+        _write_yaml(
+            os.path.join(case, "mapping.yaml"),
+            {"seed": "0x" + seed.hex(), "count": count, "mapping": mapping},
+        )
+        n += 1
+
+    return n
+
+
+if __name__ == "__main__":
+    out = sys.argv[1] if len(sys.argv) > 1 else "tests/ef/vectors"
+    total = 0
+    for fork in ("phase0", "altair"):
+        total += generate(out, fork)
+    print(f"wrote {total} cases under {out}")
