@@ -1,0 +1,72 @@
+"""Mainnet-preset smoke: the rest of the suite runs the minimal preset;
+this catches preset-dependent bugs (shape parameters, epoch geometry,
+committee math) on the mainnet shapes with a small validator set.
+
+Reference analogue: the per-fork `make test-beacon-chain-%` matrix runs
+mainnet-preset suites too.
+"""
+
+import copy
+
+import pytest
+
+from lighthouse_tpu.beacon_chain import BeaconChain
+from lighthouse_tpu.crypto import backend
+from lighthouse_tpu.ssz import hash_tree_root
+from lighthouse_tpu.state_transition import state_transition, store_replayer
+from lighthouse_tpu.store import HotColdDB, MemoryStore
+from lighthouse_tpu.testing.harness import StateHarness
+from lighthouse_tpu.types.chain_spec import mainnet_spec
+from lighthouse_tpu.types.preset import MAINNET
+from lighthouse_tpu.utils.slot_clock import ManualSlotClock
+
+
+@pytest.fixture(autouse=True)
+def fake_backend():
+    backend.set_backend("fake")
+    yield
+    backend.set_backend("cpu")
+
+
+def test_mainnet_chain_with_attestations():
+    spec = mainnet_spec()
+    h = StateHarness(MAINNET, spec, validator_count=64, fork_name="phase0",
+                     fake_sign=True)
+    genesis = copy.deepcopy(h.state)
+    db = HotColdDB(MemoryStore(), h.t, spec, store_replayer(MAINNET, spec))
+    clock = ManualSlotClock(genesis.genesis_time, spec.seconds_per_slot)
+    chain = BeaconChain(MAINNET, spec, h.t, db, genesis, slot_clock=clock)
+
+    for _ in range(3):
+        slot = h.state.slot + 1
+        clock.set_slot(slot)
+        atts = []
+        if slot >= 2:
+            atts = h.attestations_for_slot(h.state, slot - 1)[
+                : MAINNET.MAX_ATTESTATIONS
+            ]
+        sb = h.produce_block(slot, attestations=atts)
+        h.process_block(sb, strategy="none")
+        root = chain.process_block(chain.verify_block_for_gossip(sb))
+        assert chain.head_block_root == root
+    assert chain.head_state.slot == 3
+    # attestations actually landed
+    assert len(chain.head_state.previous_epoch_attestations) + len(
+        chain.head_state.current_epoch_attestations
+    ) >= 2
+    # storage round-trip at mainnet shapes
+    sr = hash_tree_root(chain.head_state)
+    assert hash_tree_root(db.get_state(sr)) == sr
+
+
+@pytest.mark.slow  # second mainnet genesis (~80s of big-vector hashing)
+def test_mainnet_state_transition_wrapper():
+    spec = mainnet_spec()
+    h = StateHarness(MAINNET, spec, validator_count=64, fork_name="altair",
+                     fake_sign=True)
+    sb = h.produce_block(1)
+    st = state_transition(
+        MAINNET, spec, copy.deepcopy(h.state), sb, signature_strategy="none"
+    )
+    assert st.slot == 1
+    assert hash_tree_root(st) == bytes(sb.message.state_root)
