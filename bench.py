@@ -6,9 +6,10 @@ aggregator signature, aggregate attestation signature over the committee —
 reference ``beacon_node/beacon_chain/src/attestation_verification/batch.rs:77-107``).
 
 END-TO-END measurement (VERDICT r1 weakness #3): every rep re-packs the
-raw (signature, pubkeys, message) sets — host point packing + randomness +
-hash_to_field — and runs the device program, which hashes the messages to
-G2 on device (``device/htc.py``) and verifies. Nothing is pre-hashed.
+raw (compressed-signature, pubkeys, message) sets — host byte wrangling +
+randomness + hash_to_field only — and runs the device program, which
+DECOMPRESSES the signatures, hashes the messages to G2 and verifies, all
+on device. No host big-int math in the hot path.
 
 Robustness (round-1 BENCH died at TPU init): the TPU backend is probed in
 a SUBPROCESS with a deadline first; if the probe fails or times out the
@@ -85,17 +86,18 @@ except Exception:
     pass
 import numpy as np, jax.numpy as jnp
 from lighthouse_tpu.crypto.device import fp
-from lighthouse_tpu.crypto.device.bls import verify_batch_hashed_fn
+from lighthouse_tpu.crypto.device.bls import verify_batch_raw_fn
 args = (
     jnp.zeros(({B_PAD}, {K_PAD}, 2, fp.NL), jnp.int32),
     jnp.zeros(({B_PAD}, {K_PAD}), bool),
-    jnp.zeros(({B_PAD}, 2, 2, fp.NL), jnp.int32),
+    jnp.zeros(({B_PAD}, 2, fp.NL), jnp.int32),
+    jnp.zeros(({B_PAD},), bool),
     jnp.zeros(({M_PAD}, 2, 2, fp.NL), jnp.int32),
     jnp.zeros(({B_PAD},), jnp.int32),
     jnp.zeros(({B_PAD}, 2), jnp.int32),
     jnp.zeros(({B_PAD},), bool),
 )
-jax.jit(verify_batch_hashed_fn).lower(*args).compile()
+jax.jit(verify_batch_raw_fn).lower(*args).compile()
 print("COMPILE_OK")
 """
     try:
@@ -121,9 +123,10 @@ def build_sets():
     pks = [sk.public_key().point for sk in sks]
     sk_agg = bls.SecretKey(sum(1_000 + i for i in range(COMMITTEE)) % R)
     msgs = [bytes([m + 1]) * 32 for m in range(N_MSGS)]
-    single0 = {m: sks[0].sign(m).point for m in msgs}
-    single1 = {m: sks[1].sign(m).point for m in msgs}
-    agg = {m: sk_agg.sign(m).point for m in msgs}
+    # signatures stay COMPRESSED (lazy Signature): the device decompresses
+    single0 = {m: bls.Signature.deserialize(sks[0].sign(m).serialize()) for m in msgs}
+    single1 = {m: bls.Signature.deserialize(sks[1].sign(m).serialize()) for m in msgs}
+    agg = {m: bls.Signature.deserialize(sk_agg.sign(m).serialize()) for m in msgs}
 
     sets = []
     for i in range(N_AGG):
@@ -155,18 +158,18 @@ def main() -> None:
         pass
 
     from lighthouse_tpu.crypto.device.bls import (
-        pack_signature_sets_hashed,
-        verify_batch_hashed,
+        pack_signature_sets_raw,
+        verify_batch_raw,
     )
 
     sets = build_sets()
     n_sets = len(sets)
 
     def run_once():
-        args = pack_signature_sets_hashed(
+        args = pack_signature_sets_raw(
             sets, pad_b=B_PAD, pad_k=K_PAD, pad_m=M_PAD
         )
-        out = verify_batch_hashed(*args)
+        out = verify_batch_raw(*args)
         jax.block_until_ready(out)
         return out
 

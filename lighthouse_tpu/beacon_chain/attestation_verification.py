@@ -15,6 +15,7 @@ import hashlib
 from dataclasses import dataclass
 
 from ..crypto import bls
+from ..crypto.bls import BlsError
 from ..ssz import hash_tree_root
 from ..state_transition.helpers import compute_epoch_at_slot
 from ..state_transition.signature_sets import (
@@ -106,11 +107,15 @@ def verify_unaggregated_attestation(chain, att, current_slot: int):
     """Single-item gossip path (reference
     ``IndexedUnaggregatedAttestation::verify``)."""
     indexed, validator_index = _structural_unaggregated(chain, att, current_slot)
-    s = indexed_attestation_set(
-        chain.preset, chain.spec, chain.head_state, indexed,
-        chain.pubkey_cache.resolver(),
-    )
-    if not bls.verify_signature_sets([s]):
+    try:
+        s = indexed_attestation_set(
+            chain.preset, chain.spec, chain.head_state, indexed,
+            chain.pubkey_cache.resolver(),
+        )
+        ok = bls.verify_signature_sets([s])
+    except BlsError:  # malformed signature bytes = invalid, never a crash
+        ok = False
+    if not ok:
         raise AttestationError("InvalidSignature")
     chain.observed_attesters.observe(validator_index, att.data.target.epoch)
     return VerifiedUnaggregatedAttestation(att, indexed, validator_index, att.data.index)
@@ -133,6 +138,8 @@ def batch_verify_unaggregated_attestations(chain, attestations, current_slot: in
                 pending.append((pos, att, indexed, vindex, s))
             except AttestationError as e:
                 results[pos] = e
+            except BlsError:
+                results[pos] = AttestationError("InvalidSignature")
     with _BATCH_SIG.time():
         batch_ok = bool(pending) and bls.verify_signature_sets(
             [p[4] for p in pending]
@@ -201,11 +208,15 @@ def _structural_aggregated(chain, signed_agg, current_slot: int):
 def verify_aggregated_attestation(chain, signed_agg, current_slot: int):
     """Single aggregate: 3 signature sets (reference ``batch.rs:77-107``)."""
     indexed, att_root = _structural_aggregated(chain, signed_agg, current_slot)
-    sets = aggregate_and_proof_sets(
-        chain.preset, chain.spec, chain.head_state, signed_agg,
-        chain.pubkey_cache.resolver(),
-    )
-    if not bls.verify_signature_sets(sets):
+    try:
+        sets = aggregate_and_proof_sets(
+            chain.preset, chain.spec, chain.head_state, signed_agg,
+            chain.pubkey_cache.resolver(),
+        )
+        ok = bls.verify_signature_sets(sets)
+    except BlsError:
+        ok = False
+    if not ok:
         raise AttestationError("InvalidSignature")
     msg = signed_agg.message
     chain.observed_aggregates.observe(att_root, msg.aggregate.data.slot)
@@ -229,6 +240,8 @@ def batch_verify_aggregated_attestations(chain, signed_aggs, current_slot: int):
                 pending.append((pos, sa, indexed, att_root, sets))
             except AttestationError as e:
                 results[pos] = e
+            except BlsError:
+                results[pos] = AttestationError("InvalidSignature")
     with _BATCH_SIG.time():
         all_sets = [s for p in pending for s in p[4]]
         batch_ok = bool(pending) and bls.verify_signature_sets(all_sets)

@@ -111,16 +111,22 @@ class SignatureVerifiedBlock:
 
     @classmethod
     def _verify(cls, chain, signed_block, block_root, state, skip_proposal):
-        acc = BlockSignatureAccumulator(
-            chain.preset, chain.spec, state, chain.pubkey_cache.resolver(),
-            resolver_by_pubkey_bytes=chain.pubkey_resolver_by_bytes(),
-        )
-        if skip_proposal:
-            acc.include_randao_reveal(signed_block.message)
-            acc.include_operations(signed_block)
-        else:
-            acc.include_all(signed_block, block_root=block_root)
-        if not acc.verify():
+        from ..crypto.bls import BlsError
+
+        try:
+            acc = BlockSignatureAccumulator(
+                chain.preset, chain.spec, state, chain.pubkey_cache.resolver(),
+                resolver_by_pubkey_bytes=chain.pubkey_resolver_by_bytes(),
+            )
+            if skip_proposal:
+                acc.include_randao_reveal(signed_block.message)
+                acc.include_operations(signed_block)
+            else:
+                acc.include_all(signed_block, block_root=block_root)
+            ok = acc.verify()
+        except BlsError:  # malformed signature bytes in the block body
+            ok = False
+        if not ok:
             raise BlockError("InvalidSignature")
         return cls(signed_block, block_root, state, skip_proposal)
 

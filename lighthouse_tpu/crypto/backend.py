@@ -14,7 +14,11 @@ converts to device tensors internally):
     verify(pk_point, message, sig_point) -> bool
     fast_aggregate_verify(pk_points, message, sig_point) -> bool
     aggregate_verify(pk_points, messages, sig_point) -> bool
-    verify_signature_sets([(sig_point, [pk_points], message32)]) -> bool
+    verify_signature_sets([(sig, [pk_points], message32)]) -> bool
+        where ``sig`` is a bls.Signature OBJECT (possibly lazy/compressed
+        — the tpu backend ships its raw bytes to the device) or a bare
+        G2 point; an off-curve lazy signature must yield False, never an
+        exception (catch bls.BlsError)
 """
 
 from __future__ import annotations
@@ -34,7 +38,22 @@ class CpuBackend:
     verify = staticmethod(_cpu.verify)
     fast_aggregate_verify = staticmethod(_cpu.fast_aggregate_verify)
     aggregate_verify = staticmethod(_cpu.aggregate_verify)
-    verify_signature_sets = staticmethod(_cpu.verify_signature_sets)
+
+    @staticmethod
+    def verify_signature_sets(sets) -> bool:
+        # materialize lazy signatures; a non-curve x is simply invalid
+        from . import bls as _bls
+
+        raw = []
+        try:
+            for sig, pks, msg in sets:
+                point = sig.point if isinstance(sig, _bls.Signature) else sig
+                if point is None:
+                    return False
+                raw.append((point, pks, msg))
+        except _bls.BlsError:
+            return False
+        return _cpu.verify_signature_sets(raw)
 
 
 class FakeBackend:

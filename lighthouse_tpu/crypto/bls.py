@@ -20,7 +20,7 @@ from typing import Optional, Sequence
 from . import backend as _backend
 from .cpu import bls as _cpu
 from .cpu.curve import G1Point, G2Point
-from .params import DST, PUBLIC_KEY_BYTES, R, SECRET_KEY_BYTES, SIGNATURE_BYTES
+from .params import P as P_MOD, DST, PUBLIC_KEY_BYTES, R, SECRET_KEY_BYTES, SIGNATURE_BYTES
 
 INFINITY_SIGNATURE = bytes([0xC0] + [0] * 95)
 INFINITY_PUBLIC_KEY = bytes([0xC0] + [0] * 47)
@@ -68,26 +68,69 @@ class PublicKey:
         return f"PublicKey(0x{self.serialize().hex()})"
 
 
+def parse_compressed_g2_x(data: bytes) -> tuple[int, int, bool]:
+    """Structural parse of a compressed G2 encoding -> (x0, x1,
+    sign_larger). Validates length, compression flag, range; the on-curve
+    check (sqrt) is the caller's business (host decompress or device)."""
+    if len(data) != SIGNATURE_BYTES:
+        raise BlsError(f"invalid signature length {len(data)}")
+    data = bytes(data)
+    flags = data[0] >> 5
+    if not flags & 0x4:
+        raise BlsError("uncompressed G2 encoding not supported")
+    if flags & 0x2:
+        raise BlsError("infinity encoding has no x")
+    x1 = int.from_bytes(bytes([data[0] & 0x1F]) + data[1:48], "big")
+    x0 = int.from_bytes(data[48:], "big")
+    if x0 >= P_MOD or x1 >= P_MOD:
+        raise BlsError("x out of range")
+    return x0, x1, bool(flags & 0x1)
+
+
 class Signature:
-    """A G2 signature; ``point`` is None for the "empty" (infinity) encoding."""
+    """A G2 signature; ``point`` is None for the "empty" (infinity)
+    encoding.
 
-    __slots__ = ("point", "_bytes")
+    Decompression is LAZY: ``deserialize`` performs only the cheap
+    structural checks (length, flags, x-range, infinity well-formedness)
+    and defers the square root until ``point`` is touched — the TPU
+    backend never touches it (G2 decompression runs ON DEVICE,
+    ``crypto/device/bls.py``), which removes ~10 ms of host big-int math
+    per gossip signature. A non-curve x (sqrt fails) therefore surfaces
+    at USE time as BlsError; batch verifiers contain it as a normal
+    invalid-signature outcome."""
 
-    def __init__(self, point: Optional[G2Point], raw: Optional[bytes] = None):
-        self.point = point
+    __slots__ = ("_point", "_bytes", "_decompressed")
+
+    def __init__(self, point: Optional[G2Point] = None, raw: Optional[bytes] = None):
+        self._point = point
         self._bytes = raw
+        self._decompressed = point is not None or raw is None
+
+    @property
+    def point(self) -> Optional[G2Point]:
+        if not self._decompressed:
+            if bytes(self._bytes) == INFINITY_SIGNATURE:
+                self._point = None
+            else:
+                try:
+                    self._point = G2Point.decompress(self._bytes)
+                except ValueError as e:
+                    raise BlsError(str(e)) from e
+            self._decompressed = True
+        return self._point
 
     @classmethod
     def deserialize(cls, data: bytes) -> "Signature":
         if len(data) != SIGNATURE_BYTES:
             raise BlsError(f"invalid signature length {len(data)}")
-        if bytes(data) == INFINITY_SIGNATURE:
+        data = bytes(data)
+        if (data[0] >> 5) & 0x2:  # infinity must be the canonical encoding
+            if data != INFINITY_SIGNATURE:
+                raise BlsError("malformed infinity encoding")
             return cls(None, INFINITY_SIGNATURE)
-        try:
-            point = G2Point.decompress(data)
-        except ValueError as e:
-            raise BlsError(str(e)) from e
-        return cls(point, bytes(data))
+        parse_compressed_g2_x(data)  # structural validation
+        return cls(None, data)  # sqrt (on-curve check) deferred
 
     @classmethod
     def infinity(cls) -> "Signature":
@@ -104,7 +147,9 @@ class Signature:
         return G2Point.infinity() if self.point is None else self.point
 
     def is_infinity(self) -> bool:
-        return self.point is None or self.point.is_infinity()
+        if not self._decompressed and self._bytes is not None:
+            return bytes(self._bytes) == INFINITY_SIGNATURE
+        return self._point is None or self._point.is_infinity()
 
     def verify(self, pk: PublicKey, message: bytes) -> bool:
         return _backend.active().verify(pk.point, message, self.point_or_infinity())
@@ -131,9 +176,10 @@ class AggregateSignature(Signature):
         if other.point is None:
             return
         if self.point is None:
-            self.point = other.point
+            self._point = other.point
         else:
-            self.point = self.point + other.point
+            self._point = self.point + other.point
+        self._decompressed = True
         self._bytes = None
 
     def fast_aggregate_verify(self, message: bytes, pks: Sequence[PublicKey]) -> bool:
@@ -216,20 +262,29 @@ class SignatureSet:
 
 def verify_signature_sets(sets: Sequence[SignatureSet]) -> bool:
     """Batch-verify; `True` iff every set verifies (modulo the standard
-    2^-64 random-linear-combination soundness)."""
+    2^-64 random-linear-combination soundness).
+
+    Backends receive the SIGNATURE OBJECTS (not decompressed points): the
+    tpu backend ships raw compressed bytes to the device and decompresses
+    there; the cpu backend materializes points lazily. A signature whose
+    x is not on the curve (lazy decompress fails) is an ordinary invalid
+    outcome, never an exception."""
     sets = list(sets)
     if not sets:
         return False
-    raw = []
+    prepared = []
     for s in sets:
         # An "empty" (infinity-encoded) signature fails the whole batch
         # before reaching any backend (blst.rs:77-83).
-        if s.signature.point is None:
+        if s.signature.is_infinity():
             return False
-        raw.append(
-            (s.signature.point, [pk.point for pk in s.signing_keys], s.message)
+        prepared.append(
+            (s.signature, [pk.point for pk in s.signing_keys], s.message)
         )
-    return _backend.active().verify_signature_sets(raw)
+    try:
+        return _backend.active().verify_signature_sets(prepared)
+    except BlsError:
+        return False
 
 
 __all__ = [

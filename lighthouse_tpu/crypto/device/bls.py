@@ -135,6 +135,65 @@ def _verify_core(pk_xy, pk_mask, sig_xy, msg_aff, rand_bits, set_mask):
     return pairing_ok & subgroup_ok & ~agg_inf_bad
 
 
+def _fp_gt(a_digits, b_digits):
+    """Strict canonical digits [..., NL] -> a > b (big-endian lexicographic:
+    the most significant differing limb decides)."""
+    diff = a_digits != b_digits
+    gt = a_digits > b_digits
+    idx = jnp.arange(fp.NL, dtype=jnp.int32)
+    msd = jnp.max(jnp.where(diff, idx + 1, 0), axis=-1)  # 0 == all equal
+    pick = jnp.take_along_axis(
+        gt, jnp.maximum(msd - 1, 0)[..., None], axis=-1
+    )[..., 0]
+    return (msd > 0) & pick
+
+
+def decompress_g2(sig_x, sign_larger):
+    """Device G2 decompression (the ~10 ms/signature host cost the gossip
+    pipeline used to pay in pure Python): y = sqrt(x^3 + 4(1+u)), sign
+    chosen by the compressed flag's lexicographic-larger rule.
+
+    sig_x: fp2 [..., 2, NL]; sign_larger: bool [...]. -> (y, ok) where
+    ``ok`` is False for x not on the curve."""
+    from . import htc
+
+    b2 = jnp.broadcast_to(fp2.const(4, 4), sig_x.shape).astype(jnp.int32)
+    gx = fp2.add(fp2.mul(fp2.sq(sig_x), sig_x), b2)
+    y, ok = htc.sqrt(gx)
+    yc = fp2.canonical(y)
+    neg_y = fp2.neg(y)
+    negc = fp2.canonical(neg_y)
+    c1_gt = _fp_gt(yc[..., 1, :], negc[..., 1, :])
+    c1_eq = jnp.all(yc[..., 1, :] == negc[..., 1, :], axis=-1)
+    c0_gt = _fp_gt(yc[..., 0, :], negc[..., 0, :])
+    y_is_larger = c1_gt | (c1_eq & c0_gt)
+    y_final = fp2.select(y_is_larger == sign_larger, y, neg_y)
+    return y_final, ok
+
+
+def verify_batch_raw_fn(
+    pk_xy, pk_mask, sig_x, sig_larger, msg_u, msg_idx, rand_bits, set_mask
+):
+    """THE flagship program: raw compressed signatures + raw
+    hash_to_field outputs in, verdict out. The host does byte wrangling
+    only; decompression, hashing-to-curve, aggregation, subgroup checks
+    and the multi-pairing all run on device."""
+    from . import htc
+
+    y, sig_ok = decompress_g2(sig_x, sig_larger)
+    sig_xy = jnp.stack([sig_x, y], axis=1)  # [B, 2(x|y), 2, NL]
+
+    msg_pts = htc.map_to_g2(msg_u)
+    mx, my, minf = curve.to_affine(fp2, msg_pts)
+    msg_aff = (
+        jnp.take(mx, msg_idx, axis=0),
+        jnp.take(my, msg_idx, axis=0),
+        jnp.take(minf, msg_idx, axis=0),
+    )
+    core = _verify_core(pk_xy, pk_mask, sig_xy, msg_aff, rand_bits, set_mask)
+    return core & jnp.all(sig_ok | ~set_mask)
+
+
 def verify_batch_fn(pk_xy, pk_mask, sig_xy, msg_xy, rand_bits, set_mask):
     """One-shot device program over pre-hashed message points. Returns a
     scalar bool: True iff every real lane's set verifies."""
@@ -163,6 +222,7 @@ def verify_batch_hashed_fn(pk_xy, pk_mask, sig_xy, msg_u, msg_idx, rand_bits, se
 
 verify_batch = jax.jit(verify_batch_fn)
 verify_batch_hashed = jax.jit(verify_batch_hashed_fn)
+verify_batch_raw = jax.jit(verify_batch_raw_fn)
 
 
 # ---------------------------------------------------------------------------
@@ -289,6 +349,65 @@ def pack_signature_sets_hashed(
     )
 
 
+def pack_signature_sets_raw(
+    sets, pad_b: int | None = None, pad_k: int | None = None,
+    pad_m: int | None = None,
+):
+    """Fully-raw packing for :func:`verify_batch_raw_fn`: ``sets`` are
+    ``(Signature-object, [pk_points], message)`` triples. Signatures stay
+    COMPRESSED — only byte parsing happens here; no host sqrt."""
+    sets = list(sets)
+    B = pad_b or _round_up(len(sets))
+    K = pad_k or _round_up(max(len(pks) for _, pks, _ in sets))
+
+    pk_xy = np.zeros((B, K, 2, fp.NL), np.int32)
+    pk_mask = np.zeros((B, K), bool)
+    sig_x = np.zeros((B, 2, fp.NL), np.int32)
+    sig_larger = np.zeros((B,), bool)
+    rand = np.zeros((B, 2), np.int32)
+    set_mask = np.zeros((B,), bool)
+
+    from .. import bls as _bls
+
+    for i, (sig, pks, _msg) in enumerate(sets):
+        xy, _ = curve.pack_g1(pks)
+        pk_xy[i, : len(pks)] = xy
+        pk_mask[i, : len(pks)] = True
+        x0, x1, larger = _bls.parse_compressed_g2_x(sig.serialize())
+        sig_x[i, 0] = fp.int_to_limbs(x0)
+        sig_x[i, 1] = fp.int_to_limbs(x1)
+        sig_larger[i] = larger
+        hi, lo = _rand_scalar_words()
+        rand[i] = (np.int32(np.uint32(hi)), np.int32(np.uint32(lo)))
+        set_mask[i] = True
+    if B > len(sets):
+        # padding lanes: the generator's x (a valid curve x) keeps the
+        # decompression uniform; their result is masked out
+        from ..cpu.curve import g2_generator
+
+        g = g2_generator()
+        sig_x[len(sets):, 0] = fp.int_to_limbs(g.x.c0.n)
+        sig_x[len(sets):, 1] = fp.int_to_limbs(g.x.c1.n)
+
+    msgs, idx = _dedup_messages([m for _, _, m in sets], pad_m)
+    msg_idx = np.zeros((B,), np.int32)
+    msg_idx[: len(sets)] = idx
+    from . import htc
+
+    msg_u = htc.messages_to_u(msgs, DST)
+
+    return (
+        jnp.asarray(pk_xy),
+        jnp.asarray(pk_mask),
+        jnp.asarray(sig_x),
+        jnp.asarray(sig_larger),
+        jnp.asarray(msg_u),
+        jnp.asarray(msg_idx),
+        jnp.asarray(rand),
+        jnp.asarray(set_mask),
+    )
+
+
 class TpuBackend:
     """Runtime backend ``"tpu"`` (see crypto/backend.py). Presents the same
     protocol as the CPU oracle backend; internally packs fixed-shape
@@ -307,15 +426,25 @@ class TpuBackend:
     # -- batch verification (the hot path) -------------------------------
 
     def verify_signature_sets(self, sets) -> bool:
+        """``sets``: (Signature-object | G2Point, [pk_points], message).
+        Signature objects keep their compressed bytes and are decompressed
+        ON DEVICE (verify_batch_raw); bare points (oracle tests) fall back
+        to the pre-decompressed program."""
+        from .. import bls as _bls
+
         sets = list(sets)
         if not sets:
             return False
+        raw_mode = all(isinstance(s, _bls.Signature) for s, _, _ in sets)
         for sig, pks, _msg in sets:
-            if sig.is_infinity() or not pks:
+            if not pks or sig.is_infinity():
                 return False
             if any(pk.is_infinity() for pk in pks):
                 return False
-        out = verify_batch_hashed(*pack_signature_sets_hashed(sets))
+        if raw_mode:
+            out = verify_batch_raw(*pack_signature_sets_raw(sets))
+        else:
+            out = verify_batch_hashed(*pack_signature_sets_hashed(sets))
         return bool(out)
 
     # -- single-set entry points (same device program, B=1 semantics) ----

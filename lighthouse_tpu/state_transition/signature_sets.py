@@ -213,16 +213,21 @@ def deposit_signature_is_valid(preset: Preset, spec: ChainSpec, deposit_data) ->
     try:
         pk = bls.PublicKey.deserialize(deposit_data.pubkey)
         sig = bls.Signature.deserialize(deposit_data.signature)
+        domain = compute_domain(
+            spec, DOMAIN_DEPOSIT, spec.genesis_fork_version, bytes(32)
+        )
+        msg = t.DepositMessage(
+            pubkey=deposit_data.pubkey,
+            withdrawal_credentials=deposit_data.withdrawal_credentials,
+            amount=deposit_data.amount,
+        )
+        root = compute_signing_root(t.DepositMessage, msg, domain)
+        # verify() may ALSO raise BlsError now: decompression is lazy, so
+        # an off-curve x surfaces here, and must skip the deposit, not
+        # fail the block (spec is_valid_deposit_signature semantics)
+        return sig.verify(pk, root)
     except bls.BlsError:
         return False
-    domain = compute_domain(spec, DOMAIN_DEPOSIT, spec.genesis_fork_version, bytes(32))
-    msg = t.DepositMessage(
-        pubkey=deposit_data.pubkey,
-        withdrawal_credentials=deposit_data.withdrawal_credentials,
-        amount=deposit_data.amount,
-    )
-    root = compute_signing_root(t.DepositMessage, msg, domain)
-    return sig.verify(pk, root)
 
 
 class BlockSignatureAccumulator:

@@ -146,3 +146,32 @@ def test_non_subgroup_signature_rejected_by_batch(rng, tpu_backend):
     evil = bls.Signature(_non_subgroup_g2())
     bad = bls.SignatureSet(evil, sets[0].signing_keys, sets[0].message)
     assert bls.verify_signature_sets([bad] + sets[1:]) is False
+
+
+def test_raw_compressed_batch_path(rng, tpu_backend):
+    """The fully-raw flagship: compressed signatures decompressed on
+    device; valid batch passes, tampered message fails, off-curve x is
+    invalid (never an exception)."""
+    sets = _make_sets(rng, 2)
+    lazy_sets = [
+        bls.SignatureSet(
+            bls.Signature.deserialize(s.signature.serialize()),
+            s.signing_keys,
+            s.message,
+        )
+        for s in sets
+    ]
+    assert bls.verify_signature_sets(lazy_sets) is True
+    bad = [
+        bls.SignatureSet(lazy_sets[0].signature, lazy_sets[0].signing_keys, b"\x55" * 32)
+    ] + lazy_sets[1:]
+    assert bls.verify_signature_sets(bad) is False
+    raw = bytearray(lazy_sets[0].signature.serialize())
+    raw[50] ^= 0x01  # off-curve x
+    evil = bls.Signature.deserialize(bytes(raw))
+    assert (
+        bls.verify_signature_sets(
+            [bls.SignatureSet(evil, lazy_sets[0].signing_keys, lazy_sets[0].message)]
+        )
+        is False
+    )
