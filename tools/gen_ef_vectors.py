@@ -163,6 +163,72 @@ def generate(out_root: str, fork: str = "phase0") -> int:
         )
         n += 1
 
+    # -- operations/voluntary_exit ---------------------------------------
+    h3 = StateHarness(
+        MINIMAL, minimal_spec(), validator_count=8, fork_name=fork,
+        fake_sign=True,
+    )
+    # old enough validators: jump past the shard-committee period
+    for _ in range(2):
+        h3.state.slot += h3.spec.shard_committee_period * MINIMAL.SLOTS_PER_EPOCH // 2
+    pre = copy.deepcopy(h3.state)
+    ex = t.SignedVoluntaryExit(
+        message=t.VoluntaryExit(epoch=0, validator_index=2),
+        signature=b"\x00" * 96,
+    )
+    post = copy.deepcopy(pre)
+    # the exit is valid BY CONSTRUCTION — a raise here is a regression
+    # and must crash generation, never flip the vector's expectation
+    st_block.process_voluntary_exit(
+        h3.preset, h3.spec, post, ex, False, state_pubkey_resolver(post)
+    )
+    case = os.path.join(base, "operations", "voluntary_exit", "pyspec_tests", "ok")
+    _write(os.path.join(case, "pre.ssz_snappy"), _ssz_snappy(state_t, pre))
+    _write(os.path.join(case, "voluntary_exit.ssz_snappy"), _ssz_snappy(t.SignedVoluntaryExit, ex))
+    _write(os.path.join(case, "post.ssz_snappy"), _ssz_snappy(state_t, post))
+    _write_yaml(os.path.join(case, "meta.yaml"), {"bls_setting": 2})
+    n += 1
+    # invalid: double exit -> no post
+    case = os.path.join(base, "operations", "voluntary_exit", "pyspec_tests", "double")
+    _write(os.path.join(case, "pre.ssz_snappy"), _ssz_snappy(state_t, post))
+    _write(os.path.join(case, "voluntary_exit.ssz_snappy"), _ssz_snappy(t.SignedVoluntaryExit, ex))
+    _write_yaml(os.path.join(case, "meta.yaml"), {"bls_setting": 2})
+    n += 1
+
+    # -- operations/attester_slashing ------------------------------------
+    h4 = StateHarness(
+        MINIMAL, minimal_spec(), validator_count=8, fork_name=fork,
+        fake_sign=True,
+    )
+    h4.extend_chain(2, strategy="none", attest=False)
+    data1 = t.AttestationData(
+        slot=1, index=0, beacon_block_root=b"\x01" * 32,
+        source=t.Checkpoint(epoch=0), target=t.Checkpoint(epoch=0, root=b"\x01" * 32),
+    )
+    data2 = t.AttestationData(
+        slot=1, index=0, beacon_block_root=b"\x02" * 32,
+        source=t.Checkpoint(epoch=0), target=t.Checkpoint(epoch=0, root=b"\x02" * 32),
+    )
+    slashing = t.AttesterSlashing(
+        attestation_1=t.IndexedAttestation(
+            attesting_indices=[1, 3], data=data1, signature=b"\x00" * 96
+        ),
+        attestation_2=t.IndexedAttestation(
+            attesting_indices=[1, 3], data=data2, signature=b"\x00" * 96
+        ),
+    )
+    pre = copy.deepcopy(h4.state)
+    post = copy.deepcopy(pre)
+    st_block.process_attester_slashing(
+        h4.preset, h4.spec, post, slashing, fork, False, state_pubkey_resolver(post)
+    )
+    case = os.path.join(base, "operations", "attester_slashing", "pyspec_tests", "double_vote")
+    _write(os.path.join(case, "pre.ssz_snappy"), _ssz_snappy(state_t, pre))
+    _write(os.path.join(case, "attester_slashing.ssz_snappy"), _ssz_snappy(t.AttesterSlashing, slashing))
+    _write(os.path.join(case, "post.ssz_snappy"), _ssz_snappy(state_t, post))
+    _write_yaml(os.path.join(case, "meta.yaml"), {"bls_setting": 2})
+    n += 1
+
     # -- shuffling (phase0 only in the official layout) ------------------
     if fork == "phase0":
         from lighthouse_tpu.state_transition import compute_shuffled_index
@@ -186,9 +252,31 @@ def generate(out_root: str, fork: str = "phase0") -> int:
     return n
 
 
+def generate_fork_vectors(out_root: str) -> int:
+    """fork/fork vectors: phase0 pre-state -> altair post-state."""
+    from lighthouse_tpu.state_transition.upgrade import upgrade_to_altair
+
+    h = StateHarness(
+        MINIMAL, minimal_spec(), validator_count=8, fork_name="phase0",
+        fake_sign=True,
+    )
+    pre = copy.deepcopy(h.state)
+    post = upgrade_to_altair(h.preset, h.spec, copy.deepcopy(pre))
+    t = h.t
+    case = os.path.join(
+        out_root, "tests", "minimal", "altair", "fork", "fork",
+        "pyspec_tests", "fork_base_state",
+    )
+    _write(os.path.join(case, "pre.ssz_snappy"), _ssz_snappy(t.state["phase0"], pre))
+    _write(os.path.join(case, "post.ssz_snappy"), _ssz_snappy(t.state["altair"], post))
+    _write_yaml(os.path.join(case, "meta.yaml"), {"fork": "altair"})
+    return 1
+
+
 if __name__ == "__main__":
     out = sys.argv[1] if len(sys.argv) > 1 else "tests/ef/vectors"
     total = 0
     for fork in ("phase0", "altair"):
         total += generate(out, fork)
+    total += generate_fork_vectors(out)
     print(f"wrote {total} cases under {out}")
