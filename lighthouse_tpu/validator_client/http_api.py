@@ -4,6 +4,9 @@ standardized key-manager routes with bearer-token auth):
     GET    /eth/v1/keystores          list local keys
     POST   /eth/v1/keystores          import keystores (+passwords)
     DELETE /eth/v1/keystores          delete keys (+ slashing data export)
+    GET    /eth/v1/remotekeys         list Web3Signer-backed keys
+    POST   /eth/v1/remotekeys         register remote keys (pubkey + url)
+    DELETE /eth/v1/remotekeys         deregister remote keys
 """
 
 from __future__ import annotations
@@ -12,6 +15,16 @@ import json
 import secrets
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+def _parse_pubkey(s: str) -> bytes:
+    """0x-prefixed 48-byte hex pubkey, strictly validated."""
+    if not isinstance(s, str) or not s.startswith("0x"):
+        raise ValueError("pubkey must be 0x-prefixed hex")
+    pk = bytes.fromhex(s[2:])
+    if len(pk) != 48:
+        raise ValueError(f"pubkey must be 48 bytes, got {len(pk)}")
+    return pk
 
 
 class KeymanagerApi:
@@ -50,6 +63,18 @@ class KeymanagerApi:
                             "readonly": False,
                         }
                         for pk in outer.store.pubkeys()
+                        if outer.store.is_local(pk)
+                    ]
+                    return self._reply(200, {"data": data})
+                if self.path == "/eth/v1/remotekeys":
+                    data = [
+                        {
+                            "pubkey": "0x" + pk.hex(),
+                            "url": outer.store.remote_url(pk),
+                            "readonly": False,
+                        }
+                        for pk in outer.store.pubkeys()
+                        if not outer.store.is_local(pk)
                     ]
                     return self._reply(200, {"data": data})
                 self._reply(404, {"message": "not found"})
@@ -75,6 +100,23 @@ class KeymanagerApi:
                         except Exception as e:
                             out.append({"status": "error", "message": str(e)})
                     return self._reply(200, {"data": out})
+                if self.path == "/eth/v1/remotekeys":
+                    from .web3signer import Web3SignerClient
+
+                    out = []
+                    for rk in body.get("remote_keys", []):
+                        try:
+                            pk = _parse_pubkey(rk["pubkey"])
+                            if outer.store.has(pk):
+                                out.append({"status": "duplicate"})
+                                continue
+                            outer.store.add_remote_key(
+                                pk, Web3SignerClient(rk["url"])
+                            )
+                            out.append({"status": "imported"})
+                        except Exception as e:
+                            out.append({"status": "error", "message": str(e)})
+                    return self._reply(200, {"data": out})
                 self._reply(404, {"message": "not found"})
 
             def do_DELETE(self):
@@ -96,6 +138,26 @@ class KeymanagerApi:
                             "slashing_protection": outer.store.slashing_db.export_json(),
                         },
                     )
+                if self.path == "/eth/v1/remotekeys":
+                    out = []
+                    for pk_hex in body.get("pubkeys", []):
+                        try:
+                            pk = _parse_pubkey(pk_hex)
+                            if not outer.store.has(pk):
+                                out.append({"status": "not_found"})
+                            elif outer.store.is_local(pk):
+                                # a LOCAL key must go through the keystores
+                                # route (which exports slashing data)
+                                out.append({
+                                    "status": "error",
+                                    "message": "local key: use /eth/v1/keystores",
+                                })
+                            else:
+                                outer.store.remove(pk)
+                                out.append({"status": "deleted"})
+                        except Exception as e:
+                            out.append({"status": "error", "message": str(e)})
+                    return self._reply(200, {"data": out})
                 self._reply(404, {"message": "not found"})
 
         self.httpd = ThreadingHTTPServer((host, port), Handler)

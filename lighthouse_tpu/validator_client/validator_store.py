@@ -67,13 +67,19 @@ class ValidatorStore:
     def add_remote_key(self, pubkey: bytes, signer) -> bytes:
         """Web3Signer-style remote signing (reference
         ``signing_method.rs`` Web3Signer variant): the private key never
-        enters this process."""
+        enters this process. Refuses to replace an existing validator
+        (a silent signing-method swap would drop a local secret key)."""
+        pubkey = bytes(pubkey)
+        if len(pubkey) != 48:
+            raise ValueError(f"pubkey must be 48 bytes, got {len(pubkey)}")
         with self._lock:
-            self._validators[bytes(pubkey)] = InitializedValidator(
-                bytes(pubkey), remote_signer=signer
+            if pubkey in self._validators:
+                raise ValueError("duplicate: validator already loaded")
+            self._validators[pubkey] = InitializedValidator(
+                pubkey, remote_signer=signer
             )
-        self.slashing_db.register_validator(bytes(pubkey))
-        return bytes(pubkey)
+        self.slashing_db.register_validator(pubkey)
+        return pubkey
 
     def add_keystore(self, keystore: dict, password: str) -> bytes:
         sk_bytes = decrypt(keystore, password)
@@ -84,6 +90,21 @@ class ValidatorStore:
     def remove(self, pubkey: bytes) -> bool:
         with self._lock:
             return self._validators.pop(pubkey, None) is not None
+
+    def has(self, pubkey: bytes) -> bool:
+        with self._lock:
+            return bytes(pubkey) in self._validators
+
+    def is_local(self, pubkey: bytes) -> bool:
+        with self._lock:
+            v = self._validators.get(bytes(pubkey))
+        return v is not None and v.secret_key is not None
+
+    def remote_url(self, pubkey: bytes) -> str:
+        with self._lock:
+            v = self._validators.get(bytes(pubkey))
+        signer = getattr(v, "remote_signer", None) if v else None
+        return getattr(signer, "base", "") if signer else ""
 
     def pubkeys(self) -> list[bytes]:
         with self._lock:

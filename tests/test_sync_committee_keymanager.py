@@ -124,3 +124,55 @@ def test_keymanager_api():
 
 
 import urllib.error  # noqa: E402  (used in the 403 assertion)
+
+
+def test_keymanager_remotekeys():
+    from lighthouse_tpu.state_transition import interop_secret_key
+    from lighthouse_tpu.validator_client.web3signer import MockWeb3Signer
+
+    h = StateHarness(
+        MINIMAL, minimal_spec(), validator_count=4, fork_name="phase0",
+        fake_sign=True,
+    )
+    store = ValidatorStore(
+        h.spec, h.preset, h.t, genesis_validators_root=b"\x01" * 32
+    )
+    sk = interop_secret_key(0)
+    signer = MockWeb3Signer([sk])
+    km = KeymanagerApi(store, port=0).start()
+    base = f"http://127.0.0.1:{km.port}"
+    auth = {"Authorization": f"Bearer {km.token}", "Content-Type": "application/json"}
+    try:
+        pk_hex = "0x" + sk.public_key().serialize().hex()
+        body = json.dumps(
+            {"remote_keys": [{"pubkey": pk_hex, "url": signer.url}]}
+        ).encode()
+        req = urllib.request.Request(base + "/eth/v1/remotekeys", data=body, headers=auth)
+        with urllib.request.urlopen(req, timeout=5) as r:
+            assert json.load(r)["data"][0]["status"] == "imported"
+        # listed under remotekeys, not keystores
+        req = urllib.request.Request(base + "/eth/v1/remotekeys", headers=auth)
+        with urllib.request.urlopen(req, timeout=5) as r:
+            listed = json.load(r)["data"]
+        assert listed[0]["pubkey"] == pk_hex and listed[0]["url"] == signer.url
+        req = urllib.request.Request(base + "/eth/v1/keystores", headers=auth)
+        with urllib.request.urlopen(req, timeout=5) as r:
+            assert json.load(r)["data"] == []
+        # the remote key actually signs (through the mock signer)
+        data = h.t.AttestationData(
+            slot=8, index=0,
+            source=h.t.Checkpoint(epoch=0), target=h.t.Checkpoint(epoch=1),
+        )
+        sig = store.sign_attestation(bytes.fromhex(pk_hex[2:]), data)
+        assert len(sig) == 96
+        # delete
+        body = json.dumps({"pubkeys": [pk_hex]}).encode()
+        req = urllib.request.Request(
+            base + "/eth/v1/remotekeys", data=body, headers=auth, method="DELETE"
+        )
+        with urllib.request.urlopen(req, timeout=5) as r:
+            assert json.load(r)["data"][0]["status"] == "deleted"
+        assert store.pubkeys() == []
+    finally:
+        km.stop()
+        signer.stop()
