@@ -19,6 +19,9 @@ consume):
     POST /eth/v1/beacon/pool/voluntary_exits
     POST /eth/v1/beacon/pool/attester_slashings
     POST /eth/v1/beacon/pool/proposer_slashings
+    GET  /eth/v1/beacon/states/{state_id}/committees
+    GET  /eth/v1/node/identity | /eth/v1/node/peers
+    GET  /eth/v1/beacon/light_client/{bootstrap/{root},finality_update,optimistic_update}
     POST /eth/v1/beacon/pool/sync_committees
     GET  /eth/v2/debug/beacon/states/{state_id}  (SSZ, checkpoint sync)
     GET  /eth/v1/config/spec
@@ -337,6 +340,111 @@ class BeaconApiServer:
                     }
                 )
             return {"data": out}
+
+        m = re.fullmatch(r"/eth/v1/beacon/states/([^/]+)/committees", path)
+        if m:
+            st = self._state_for(m.group(1))
+            P = chain.preset
+            try:
+                epoch = (
+                    int(query["epoch"])
+                    if "epoch" in query
+                    else st.slot // P.SLOTS_PER_EPOCH
+                )
+                want_slot = int(query["slot"]) if "slot" in query else None
+                want_index = int(query["index"]) if "index" in query else None
+            except ValueError:
+                raise ApiError(400, "malformed epoch/slot/index parameter")
+            head_epoch = chain.head_state.slot // P.SLOTS_PER_EPOCH
+            # lookahead is only defined one epoch out; unbounded epochs
+            # would make the shuffling cache advance a state arbitrarily
+            # far (CPU DoS)
+            if epoch > head_epoch + 1:
+                raise ApiError(400, f"epoch {epoch} beyond lookahead")
+            cache = chain.shuffling_cache.get(chain, epoch, chain.head_block_root)
+            out = []
+            for slot in range(
+                epoch * P.SLOTS_PER_EPOCH, (epoch + 1) * P.SLOTS_PER_EPOCH
+            ):
+                if want_slot is not None and slot != want_slot:
+                    continue
+                for index in range(cache.committees_per_slot):
+                    if want_index is not None and index != want_index:
+                        continue
+                    out.append(
+                        {
+                            "index": str(index),
+                            "slot": str(slot),
+                            "validators": [
+                                str(int(v)) for v in cache.committee(slot, index)
+                            ],
+                        }
+                    )
+            return {"data": out}
+
+        if path == "/eth/v1/node/identity":
+            net = getattr(chain, "network", None)
+            return {
+                "data": {
+                    "peer_id": f"lighthouse_tpu-{chain.genesis_block_root.hex()[:8]}",
+                    "enr": "",
+                    "p2p_addresses": (
+                        [f"/ip4/127.0.0.1/tcp/{net.port}"] if net else []
+                    ),
+                    "discovery_addresses": [],
+                    "metadata": {"seq_number": "0", "attnets": "0x" + "ff" * 8},
+                }
+            }
+        if path == "/eth/v1/node/peers":
+            net = getattr(chain, "network", None)
+            peers = []
+            if net is not None:
+                for peer in net.transport.peers:
+                    peers.append(
+                        {
+                            "peer_id": f"{peer.addr[0]}:{peer.remote_listen_port or peer.addr[1]}",
+                            "last_seen_p2p_address": f"/ip4/{peer.addr[0]}/tcp/{peer.addr[1]}",
+                            "state": "connected",
+                            "direction": "outbound",
+                        }
+                    )
+            return {"data": peers, "meta": {"count": len(peers)}}
+
+        m = re.fullmatch(r"/eth/v1/beacon/light_client/bootstrap/([^/]+)", path)
+        if m:
+            from ..beacon_chain.light_client import produce_bootstrap
+
+            # the id is a BLOCK root per the beacon-API spec
+            _root, block = self._block_for(m.group(1))
+            st = chain.store.get_state(bytes(block.message.state_root))
+            if st is None:
+                raise ApiError(404, "state for bootstrap block unavailable")
+            if not hasattr(st, "current_sync_committee"):
+                raise ApiError(400, "pre-altair state has no light-client data")
+            boot = produce_bootstrap(chain, st)
+            return {"version": fork_of(st), "data": to_json(type(boot), boot)}
+        if path == "/eth/v1/beacon/light_client/finality_update":
+            from ..beacon_chain.light_client import produce_finality_update
+
+            if not hasattr(chain.head_state, "current_sync_committee"):
+                raise ApiError(400, "pre-altair state has no light-client data")
+            upd = produce_finality_update(chain)
+            if upd is None:
+                raise ApiError(404, "no finality yet")
+            return {
+                "version": fork_of(chain.head_state),
+                "data": to_json(type(upd), upd),
+            }
+        if path == "/eth/v1/beacon/light_client/optimistic_update":
+            from ..beacon_chain.light_client import produce_optimistic_update
+
+            if not hasattr(chain.head_state, "current_sync_committee"):
+                raise ApiError(400, "pre-altair state has no light-client data")
+            upd = produce_optimistic_update(chain)
+            return {
+                "version": fork_of(chain.head_state),
+                "data": to_json(type(upd), upd),
+            }
 
         m = re.fullmatch(r"/eth/v2/debug/beacon/states/([^/]+)", path)
         if m:

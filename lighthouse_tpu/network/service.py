@@ -102,6 +102,8 @@ class NetworkService:
         self._seen_lock = threading.Lock()
         self.sync = RangeSync(self)
         self.backfill = BackfillSync(self)
+        # the HTTP API's /node/identity + /node/peers read this
+        chain.network = self
 
     @property
     def topics(self) -> Topics:

@@ -171,3 +171,76 @@ def test_sse_events_stream(node):
     t.join(timeout=10)
     assert any(e == "event: head" for e in events), events
     assert any(e.startswith("data:") and '"block"' in e for e in events), events
+
+
+def test_committees_identity_and_light_client_routes(node):
+    import urllib.request
+    import urllib.error
+
+    h, chain, clock, server = node
+    base = f"http://127.0.0.1:{server.port}"
+    with urllib.request.urlopen(base + "/eth/v1/beacon/states/head/committees", timeout=5) as r:
+        committees = __import__("json").load(r)["data"]
+    assert committees and all("validators" in c for c in committees)
+    total = sum(len(c["validators"]) for c in committees)
+    assert total == N_VALIDATORS  # every validator appears exactly once per epoch
+    with urllib.request.urlopen(base + "/eth/v1/node/identity", timeout=5) as r:
+        ident = __import__("json").load(r)["data"]
+    assert ident["peer_id"]
+    with urllib.request.urlopen(base + "/eth/v1/node/peers", timeout=5) as r:
+        peers = __import__("json").load(r)
+    assert peers["meta"]["count"] == 0  # no network service attached here
+    # phase0 chain: light-client routes reply 400 (no sync committees)
+    import pytest as _pytest
+
+    with _pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(base + "/eth/v1/beacon/light_client/optimistic_update", timeout=5)
+    assert e.value.code == 400
+
+
+def test_light_client_routes_altair():
+    import json as _json
+    import urllib.request
+
+    h = StateHarness(
+        MINIMAL, minimal_spec(), validator_count=8, fork_name="altair",
+        fake_sign=True,
+    )
+    genesis = copy.deepcopy(h.state)
+    db = HotColdDB(MemoryStore(), h.t, h.spec, store_replayer(h.preset, h.spec))
+    clock = ManualSlotClock(genesis.genesis_time, h.spec.seconds_per_slot)
+    chain = BeaconChain(h.preset, h.spec, h.t, db, genesis, slot_clock=clock)
+    chain.op_pool = OperationPool(h.preset, h.spec, h.t)
+    server = BeaconApiServer(chain, port=0).start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(
+            base + "/eth/v1/beacon/light_client/optimistic_update", timeout=5
+        ) as r:
+            upd = _json.load(r)
+        assert upd["version"] == "altair"
+        assert "attested_header" in upd["data"]
+        with urllib.request.urlopen(
+            base + "/eth/v1/beacon/light_client/bootstrap/head", timeout=5
+        ) as r:
+            boot = _json.load(r)
+        assert len(boot["data"]["current_sync_committee_branch"]) == 5
+        # block-ROOT form (the spec's primary form)
+        root_hex = "0x" + chain.head_block_root.hex()
+        with urllib.request.urlopen(
+            base + f"/eth/v1/beacon/light_client/bootstrap/{root_hex}", timeout=5
+        ) as r:
+            boot2 = _json.load(r)
+        assert boot2["data"]["header"] == boot["data"]["header"]
+        # malformed epoch parameter -> 400, not 500
+        import urllib.error as _err
+
+        try:
+            urllib.request.urlopen(
+                base + "/eth/v1/beacon/states/head/committees?epoch=abc", timeout=5
+            )
+            raise AssertionError("expected 400")
+        except _err.HTTPError as e:
+            assert e.code == 400
+    finally:
+        server.stop()
