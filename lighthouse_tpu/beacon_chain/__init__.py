@@ -26,6 +26,7 @@ from .observed import (
     ObservedOperations,
 )
 from .pubkey_cache import ValidatorPubkeyCache
+from .validator_monitor import ValidatorMonitor
 
 __all__ = [
     "AttestationError",
@@ -42,6 +43,7 @@ __all__ = [
     "ShufflingCache",
     "SignatureVerifiedBlock",
     "SnapshotCache",
+    "ValidatorMonitor",
     "ValidatorPubkeyCache",
     "VerifiedAggregatedAttestation",
     "VerifiedUnaggregatedAttestation",

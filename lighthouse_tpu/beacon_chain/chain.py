@@ -149,6 +149,7 @@ class BeaconChain:
         self.shuffling_cache = ShufflingCache()
         self.root_computer = CachedRootComputer()
         self.op_pool = None  # attached by the client builder when present
+        self.validator_monitor = None  # attached when monitoring is on
 
         self.head_block_root = genesis_block_root
         self.head_state = genesis_state
@@ -265,6 +266,8 @@ class BeaconChain:
             )
 
         self.pubkey_cache.import_new_pubkeys(state)
+        if self.validator_monitor is not None:
+            self.validator_monitor.process_block(self, signed_block, state)
         self.store.put_block(sv.block_root, signed_block)
         self.store.put_state(post_root, state)
         self.snapshot_cache.insert(sv.block_root, state)

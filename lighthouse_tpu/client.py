@@ -33,6 +33,8 @@ class ClientConfig:
     bls_backend: str = "cpu"  # cpu | fake | tpu — the north-star flag
     n_workers: int = 2
     slots_per_snapshot: int = 32
+    # None = off; "auto" = monitor every validator; or a list of indices
+    monitor_validators: object = None
 
 
 class Client:
@@ -53,10 +55,15 @@ class Client:
         return self
 
     def stop(self):
-        self._stop.set()
-        if self.api is not None:
-            self.api.stop()
-        self.processor.shutdown()
+        try:
+            self._stop.set()
+            if self.api is not None:
+                self.api.stop()
+            self.processor.shutdown()
+        finally:
+            lock = getattr(self, "_lock", None)
+            if lock is not None:
+                lock.release()
 
 
 class ClientBuilder:
@@ -114,6 +121,19 @@ class ClientBuilder:
 
         bls_backend.set_backend(cfg.bls_backend)
 
+        lock = None
+        if cfg.datadir:
+            from .utils import Lockfile
+
+            lock = Lockfile(f"{cfg.datadir}/beacon.lock").acquire()
+        try:
+            return self._build_locked(cfg, lock)
+        except BaseException:
+            if lock is not None:
+                lock.release()  # a failed build must not wedge the datadir
+            raise
+
+    def _build_locked(self, cfg, lock) -> Client:
         kv = (
             SqliteStore(f"{cfg.datadir}/chain.sqlite")
             if cfg.datadir
@@ -154,6 +174,14 @@ class ClientBuilder:
             self.preset, self.spec, self.types, store, genesis, slot_clock=clock
         )
         chain.op_pool = OperationPool(self.preset, self.spec, self.types)
+        if cfg.monitor_validators is not None:
+            from .beacon_chain import ValidatorMonitor
+
+            monitor = ValidatorMonitor(auto=cfg.monitor_validators == "auto")
+            if isinstance(cfg.monitor_validators, (list, tuple, set)):
+                for i in cfg.monitor_validators:
+                    monitor.add_validator(int(i))
+            chain.validator_monitor = monitor
         # checkpoint sync: store the anchor block so lookups resolve and
         # backfill has a starting parent
         cp_block = getattr(self, "_checkpoint_block", None)
@@ -174,6 +202,7 @@ class ClientBuilder:
         )
         client = Client(chain, processor, api, clock, timer)
         client._stop = stop
+        client._lock = lock
         return client
 
 

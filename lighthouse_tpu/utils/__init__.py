@@ -1,7 +1,15 @@
 """Cross-cutting utilities (reference: ``common/`` crates — slot_clock,
 lighthouse_metrics, task_executor, logging)."""
 
+from .lockfile import Lockfile, LockfileError
 from .slot_clock import ManualSlotClock, SlotClock, SystemTimeSlotClock
 from . import metrics
 
-__all__ = ["ManualSlotClock", "SlotClock", "SystemTimeSlotClock", "metrics"]
+__all__ = [
+    "Lockfile",
+    "LockfileError",
+    "ManualSlotClock",
+    "SlotClock",
+    "SystemTimeSlotClock",
+    "metrics",
+]

@@ -1,0 +1,66 @@
+"""Validator monitor + datadir lockfile."""
+
+import copy
+import os
+
+import pytest
+
+from lighthouse_tpu.beacon_chain import BeaconChain, ValidatorMonitor
+from lighthouse_tpu.crypto import backend
+from lighthouse_tpu.state_transition import store_replayer
+from lighthouse_tpu.store import HotColdDB, MemoryStore
+from lighthouse_tpu.testing.harness import StateHarness
+from lighthouse_tpu.types.chain_spec import minimal_spec
+from lighthouse_tpu.types.preset import MINIMAL
+from lighthouse_tpu.utils import Lockfile, LockfileError
+from lighthouse_tpu.utils.slot_clock import ManualSlotClock
+
+
+@pytest.fixture(autouse=True)
+def fake_backend():
+    backend.set_backend("fake")
+    yield
+    backend.set_backend("cpu")
+
+
+def test_validator_monitor_tracks_inclusions_and_proposals():
+    h = StateHarness(MINIMAL, minimal_spec(), validator_count=8, fake_sign=True)
+    genesis = copy.deepcopy(h.state)
+    db = HotColdDB(MemoryStore(), h.t, h.spec, store_replayer(h.preset, h.spec))
+    clock = ManualSlotClock(genesis.genesis_time, h.spec.seconds_per_slot)
+    chain = BeaconChain(h.preset, h.spec, h.t, db, genesis, slot_clock=clock)
+    chain.validator_monitor = ValidatorMonitor(auto=True)
+
+    for _ in range(4):
+        slot = h.state.slot + 1
+        clock.set_slot(slot)
+        atts = []
+        if slot >= 2:
+            atts = h.attestations_for_slot(h.state, slot - 1)
+        sb = h.produce_block(slot, attestations=atts)
+        h.process_block(sb, strategy="none")
+        chain.process_block(chain.verify_block_for_gossip(sb))
+
+    summary = chain.validator_monitor.summary()
+    assert sum(r["blocks_proposed"] for r in summary) == 4
+    assert sum(r["attestations_included"] for r in summary) >= 3
+    delays = [
+        r["last_inclusion_delay"]
+        for r in summary
+        if r["last_inclusion_delay"] is not None
+    ]
+    assert delays and all(d >= 1 for d in delays)
+
+
+def test_lockfile_guards_datadir(tmp_path):
+    path = str(tmp_path / "beacon.lock")
+    with Lockfile(path):
+        assert os.path.exists(path)
+        with pytest.raises(LockfileError):
+            Lockfile(path).acquire()  # same (live) pid holds it
+    assert not os.path.exists(path)
+    # stale lock from a dead pid is reclaimed
+    with open(path, "w") as f:
+        f.write("999999999")
+    with Lockfile(path):
+        pass
