@@ -106,28 +106,44 @@ def _structural_unaggregated(chain, att, current_slot: int):
 def verify_unaggregated_attestation(chain, att, current_slot: int):
     """Single-item gossip path (reference
     ``IndexedUnaggregatedAttestation::verify``)."""
-    indexed, validator_index = _structural_unaggregated(chain, att, current_slot)
-    try:
-        s = indexed_attestation_set(
-            chain.preset, chain.spec, chain.head_state, indexed,
-            chain.pubkey_cache.resolver(),
+    # Lock discipline (reference: RwLock-guarded caches around a lock-free
+    # signature check): structural checks + set building read shared chain
+    # state under the chain lock, the BLS call runs WITHOUT it, and the
+    # observed-cache commit re-takes it — observe() returning True then
+    # catches any racing duplicate.
+    with chain._chain_lock:
+        indexed, validator_index = _structural_unaggregated(
+            chain, att, current_slot
         )
+        try:
+            s = indexed_attestation_set(
+                chain.preset, chain.spec, chain.head_state, indexed,
+                chain.pubkey_cache.resolver(),
+            )
+        except BlsError:
+            raise AttestationError("InvalidSignature")
+    try:
         ok = bls.verify_signature_sets([s])
     except BlsError:  # malformed signature bytes = invalid, never a crash
         ok = False
     if not ok:
         raise AttestationError("InvalidSignature")
-    chain.observed_attesters.observe(validator_index, att.data.target.epoch)
+    with chain._chain_lock:
+        if chain.observed_attesters.observe(validator_index, att.data.target.epoch):
+            raise AttestationError("PriorAttestationKnown")
     return VerifiedUnaggregatedAttestation(att, indexed, validator_index, att.data.index)
 
 
 def batch_verify_unaggregated_attestations(chain, attestations, current_slot: int):
     """One backend call for the whole batch; identical per-item results to
     the single path (reference ``batch.rs:139-222``). Returns a list of
-    ``VerifiedUnaggregatedAttestation | AttestationError`` per input."""
+    ``VerifiedUnaggregatedAttestation | AttestationError`` per input.
+
+    The heavy BLS batch runs outside the chain lock so worker threads
+    verify concurrently; setup and the observed-cache commit take it."""
     results: list[object] = [None] * len(attestations)
     pending = []  # (pos, att, indexed, validator_index, set)
-    with _BATCH_SETUP.time():
+    with chain._chain_lock, _BATCH_SETUP.time():
         for pos, att in enumerate(attestations):
             try:
                 indexed, vindex = _structural_unaggregated(chain, att, current_slot)
@@ -144,19 +160,26 @@ def batch_verify_unaggregated_attestations(chain, attestations, current_slot: in
         batch_ok = bool(pending) and bls.verify_signature_sets(
             [p[4] for p in pending]
         )
-    for pos, att, indexed, vindex, s in pending:
-        if batch_ok or bls.verify_signature_sets([s]):
-            # observe() returning True = duplicate WITHIN this batch (the
-            # pre-batch is_known check ran before any item was observed);
-            # reject it exactly as the sequential path would.
-            if chain.observed_attesters.observe(vindex, att.data.target.epoch):
-                results[pos] = AttestationError("PriorAttestationKnown")
+        # per-item fallback (reference batch.rs:115-119) — still unlocked
+        item_ok = {
+            p[0]: batch_ok or bls.verify_signature_sets([p[4]])
+            for p in pending
+        }
+    with chain._chain_lock:
+        for pos, att, indexed, vindex, s in pending:
+            if item_ok[pos]:
+                # observe() returning True = duplicate within this batch or
+                # a racing thread (the pre-batch is_known check ran before
+                # any item was observed); reject it exactly as the
+                # sequential path would.
+                if chain.observed_attesters.observe(vindex, att.data.target.epoch):
+                    results[pos] = AttestationError("PriorAttestationKnown")
+                else:
+                    results[pos] = VerifiedUnaggregatedAttestation(
+                        att, indexed, vindex, att.data.index
+                    )
             else:
-                results[pos] = VerifiedUnaggregatedAttestation(
-                    att, indexed, vindex, att.data.index
-                )
-        else:
-            results[pos] = AttestationError("InvalidSignature")
+                results[pos] = AttestationError("InvalidSignature")
     return results
 
 
@@ -206,30 +229,44 @@ def _structural_aggregated(chain, signed_agg, current_slot: int):
 
 
 def verify_aggregated_attestation(chain, signed_agg, current_slot: int):
-    """Single aggregate: 3 signature sets (reference ``batch.rs:77-107``)."""
-    indexed, att_root = _structural_aggregated(chain, signed_agg, current_slot)
+    """Single aggregate: 3 signature sets (reference ``batch.rs:77-107``).
+    Same lock discipline as the unaggregated path: BLS runs unlocked."""
+    with chain._chain_lock:
+        indexed, att_root = _structural_aggregated(chain, signed_agg, current_slot)
+        try:
+            sets = aggregate_and_proof_sets(
+                chain.preset, chain.spec, chain.head_state, signed_agg,
+                chain.pubkey_cache.resolver(),
+            )
+        except BlsError:
+            raise AttestationError("InvalidSignature")
     try:
-        sets = aggregate_and_proof_sets(
-            chain.preset, chain.spec, chain.head_state, signed_agg,
-            chain.pubkey_cache.resolver(),
-        )
         ok = bls.verify_signature_sets(sets)
     except BlsError:
         ok = False
     if not ok:
         raise AttestationError("InvalidSignature")
     msg = signed_agg.message
-    chain.observed_aggregates.observe(att_root, msg.aggregate.data.slot)
-    chain.observed_aggregators.observe(msg.aggregator_index, msg.aggregate.data.target.epoch)
+    with chain._chain_lock:
+        # Root first, and only observe the aggregator for an actually-new
+        # aggregate — a rejected duplicate root must not burn the
+        # aggregator for the whole epoch (the reference checks
+        # observed_aggregates before recording the aggregator).
+        if chain.observed_aggregates.observe(att_root, msg.aggregate.data.slot):
+            raise AttestationError("AttestationAlreadyKnown")
+        if chain.observed_aggregators.observe(
+            msg.aggregator_index, msg.aggregate.data.target.epoch
+        ):
+            raise AttestationError("AggregatorAlreadyKnown")
     return VerifiedAggregatedAttestation(signed_agg, indexed, msg.aggregator_index)
 
 
 def batch_verify_aggregated_attestations(chain, signed_aggs, current_slot: int):
     """3N sets in one backend call, per-item fallback on failure
-    (reference ``batch.rs:31-134``)."""
+    (reference ``batch.rs:31-134``). BLS runs outside the chain lock."""
     results: list[object] = [None] * len(signed_aggs)
     pending = []
-    with _BATCH_SETUP.time():
+    with chain._chain_lock, _BATCH_SETUP.time():
         for pos, sa in enumerate(signed_aggs):
             try:
                 indexed, att_root = _structural_aggregated(chain, sa, current_slot)
@@ -245,25 +282,30 @@ def batch_verify_aggregated_attestations(chain, signed_aggs, current_slot: int):
     with _BATCH_SIG.time():
         all_sets = [s for p in pending for s in p[4]]
         batch_ok = bool(pending) and bls.verify_signature_sets(all_sets)
-    for pos, sa, indexed, att_root, sets in pending:
-        if batch_ok or bls.verify_signature_sets(sets):
-            msg = sa.message
-            # intra-batch duplicates: observe() returns True when another
-            # item of this batch already recorded the root/aggregator
-            dup_root = chain.observed_aggregates.observe(
-                att_root, msg.aggregate.data.slot
-            )
-            dup_aggregator = chain.observed_aggregators.observe(
-                msg.aggregator_index, msg.aggregate.data.target.epoch
-            )
-            if dup_root:
-                results[pos] = AttestationError("AttestationAlreadyKnown")
-            elif dup_aggregator:
-                results[pos] = AttestationError("AggregatorAlreadyKnown")
+        item_ok = {
+            p[0]: batch_ok or bls.verify_signature_sets(p[4])
+            for p in pending
+        }
+    with chain._chain_lock:
+        for pos, sa, indexed, att_root, sets in pending:
+            if item_ok[pos]:
+                msg = sa.message
+                # intra-batch (or cross-thread) duplicates: observe()
+                # returns True when the root/aggregator is already
+                # recorded. Root first; a duplicate root must not burn
+                # the aggregator for the epoch.
+                if chain.observed_aggregates.observe(
+                    att_root, msg.aggregate.data.slot
+                ):
+                    results[pos] = AttestationError("AttestationAlreadyKnown")
+                elif chain.observed_aggregators.observe(
+                    msg.aggregator_index, msg.aggregate.data.target.epoch
+                ):
+                    results[pos] = AttestationError("AggregatorAlreadyKnown")
+                else:
+                    results[pos] = VerifiedAggregatedAttestation(
+                        sa, indexed, msg.aggregator_index
+                    )
             else:
-                results[pos] = VerifiedAggregatedAttestation(
-                    sa, indexed, msg.aggregator_index
-                )
-        else:
-            results[pos] = AttestationError("InvalidSignature")
+                results[pos] = AttestationError("InvalidSignature")
     return results

@@ -13,6 +13,7 @@ block imports batch every block signature through
 from __future__ import annotations
 
 import copy
+import threading
 from collections import OrderedDict
 
 from ..fork_choice.fork_choice import ForkChoice
@@ -64,18 +65,23 @@ class SnapshotCache:
     def __init__(self, cap: int = 4):
         self.cap = cap
         self._map: OrderedDict[bytes, object] = OrderedDict()
+        # get() is a mutating read (move_to_end) on a plain OrderedDict and
+        # is reached from HTTP/timer threads outside the chain lock.
+        self._lock = threading.Lock()
 
     def insert(self, block_root: bytes, state) -> None:
-        self._map[block_root] = state
-        self._map.move_to_end(block_root)
-        while len(self._map) > self.cap:
-            self._map.popitem(last=False)
+        with self._lock:
+            self._map[block_root] = state
+            self._map.move_to_end(block_root)
+            while len(self._map) > self.cap:
+                self._map.popitem(last=False)
 
     def get(self, block_root: bytes):
-        state = self._map.get(block_root)
-        if state is not None:
-            self._map.move_to_end(block_root)
-        return state
+        with self._lock:
+            state = self._map.get(block_root)
+            if state is not None:
+                self._map.move_to_end(block_root)
+            return state
 
 
 class ShufflingCache:
@@ -85,13 +91,20 @@ class ShufflingCache:
     def __init__(self, cap: int = 16):
         self.cap = cap
         self._map: OrderedDict[tuple, CommitteeCache] = OrderedDict()
+        # Double-checked locking: the lock guards only dict access; a
+        # cold-miss committee build (deepcopy + epoch of state advance,
+        # potentially seconds) runs UNLOCKED so an HTTP duties request
+        # can never stall a worker that holds the chain lock and blocks
+        # here. The price is an occasional duplicate build.
+        self._lock = threading.Lock()
 
     def get(self, chain, epoch: int, target_root: bytes) -> CommitteeCache:
         key = (epoch, bytes(target_root))
-        hit = self._map.get(key)
-        if hit is not None:
-            self._map.move_to_end(key)
-            return hit
+        with self._lock:
+            hit = self._map.get(key)
+            if hit is not None:
+                self._map.move_to_end(key)
+                return hit
         # The shuffling must come from a state on the TARGET's chain — the
         # head may be on a competing fork with a different RANDAO seed.
         # Advance the target block's post-state to the epoch if needed.
@@ -102,12 +115,17 @@ class ShufflingCache:
         target_epoch_slot = epoch * chain.preset.SLOTS_PER_EPOCH
         if state.slot < target_epoch_slot:
             state = partial_state_advance(
-                chain.preset, chain.spec, copy.deepcopy(state), target_epoch_slot
+                chain.preset, chain.spec, copy.deepcopy(state),
+                target_epoch_slot,
             )
         cache = CommitteeCache(chain.preset, state, epoch)
-        self._map[key] = cache
-        while len(self._map) > self.cap:
-            self._map.popitem(last=False)
+        with self._lock:
+            existing = self._map.get(key)
+            if existing is not None:
+                return existing
+            self._map[key] = cache
+            while len(self._map) > self.cap:
+                self._map.popitem(last=False)
         return cache
 
 
@@ -151,9 +169,20 @@ class BeaconChain:
         self.op_pool = None  # attached by the client builder when present
         self.validator_monitor = None  # attached when monitoring is on
 
-        self.head_block_root = genesis_block_root
-        self.head_state = genesis_state
+        # (root, state) swapped as ONE tuple so unlocked readers (HTTP
+        # routes, duty production) always see a consistent pair; exposed
+        # via the head_block_root / head_state properties.
+        self._head = (genesis_block_root, genesis_state)
         self._last_finalized_epoch = genesis_state.finalized_checkpoint.epoch
+        # Serializes every chain-mutating path (block import, attestation
+        # verification bookkeeping, head recompute). The BeaconProcessor
+        # runs multiple worker threads plus the slot-timer and HTTP
+        # threads; the fork-choice proto-array, observed_* caches, and
+        # snapshot/shuffling caches are plain dicts with no internal
+        # locking — the reference guards the equivalents with RwLocks
+        # (canonical_head.rs). Reentrant: process_chain_segment →
+        # _import_block → recompute_head all take it.
+        self._chain_lock = threading.RLock()
 
         # Materialize the anchor block implied by the state's header (an
         # interop/spec genesis has an empty body); lets block_id lookups
@@ -186,6 +215,23 @@ class BeaconChain:
 
     # -- clock / lookup ---------------------------------------------------
 
+    @property
+    def head_block_root(self) -> bytes:
+        return self._head[0]
+
+    @property
+    def head_state(self):
+        return self._head[1]
+
+    def head_info(self):
+        """Consistent (head_block_root, head_state) pair for readers."""
+        return self._head
+
+    def set_head(self, root: bytes, state) -> None:
+        """Atomic head replacement (fork_revert, checkpoint resume)."""
+        with self._chain_lock:
+            self._head = (root, state)
+
     def slot(self) -> int:
         return self.slot_clock.now()
 
@@ -217,13 +263,17 @@ class BeaconChain:
     # -- block pipeline ---------------------------------------------------
 
     def verify_block_for_gossip(self, signed_block) -> GossipVerifiedBlock:
-        return GossipVerifiedBlock.new(self, signed_block)
+        # Mutates observed_block_producers and reads fork-choice/snapshot
+        # state; gossip blocks arrive ~1/slot, so holding the lock across
+        # the single proposal-signature check costs nothing.
+        with self._chain_lock:
+            return GossipVerifiedBlock.new(self, signed_block)
 
     def process_block(self, block, execution_status=ExecutionStatus.IRRELEVANT):
         """Import a block through the full pipeline. Accepts a raw
         SignedBeaconBlock, a GossipVerifiedBlock, or a
         SignatureVerifiedBlock; returns the block root."""
-        with _BLOCK_PROCESSING.time():
+        with self._chain_lock, _BLOCK_PROCESSING.time():
             if isinstance(block, GossipVerifiedBlock):
                 sv = SignatureVerifiedBlock.from_gossip(block, self)
             elif isinstance(block, SignatureVerifiedBlock):
@@ -284,8 +334,16 @@ class BeaconChain:
         blocks = list(blocks)
         if not blocks:
             return []
+        # The segment-wide BLS batch (the slowest single operation in the
+        # system) runs on deep-copied state OUTSIDE the chain lock; only
+        # the per-block imports lock, so gossip verification and ticks can
+        # interleave with a long sync segment.
         verified = self.signature_verify_chain_segment(blocks)
-        return [self._import_block(sv, ExecutionStatus.IRRELEVANT) for sv in verified]
+        out = []
+        for sv in verified:
+            with self._chain_lock:
+                out.append(self._import_block(sv, ExecutionStatus.IRRELEVANT))
+        return out
 
     def signature_verify_chain_segment(self, blocks) -> list[SignatureVerifiedBlock]:
         """Accumulate signature sets across all blocks of a contiguous
@@ -324,6 +382,10 @@ class BeaconChain:
 
     # -- attestation pipeline ---------------------------------------------
 
+    # The verify functions take the chain lock internally at the right
+    # granularity (setup + commit locked, the BLS call unlocked) so the
+    # heavy signature work of concurrent workers is not serialized.
+
     def verify_unaggregated_attestation_for_gossip(self, att):
         return verify_unaggregated_attestation(self, att, self.slot())
 
@@ -334,23 +396,46 @@ class BeaconChain:
         return verify_aggregated_attestation(self, signed_agg, self.slot())
 
     def batch_verify_aggregated_attestations_for_gossip(self, signed_aggs):
-        return batch_verify_aggregated_attestations(self, signed_aggs, self.slot())
+        return batch_verify_aggregated_attestations(
+            self, signed_aggs, self.slot()
+        )
 
     def apply_attestation_to_fork_choice(self, verified) -> None:
-        self.fork_choice.on_attestation(self.slot(), verified.indexed)
+        with self._chain_lock:
+            self.fork_choice.on_attestation(self.slot(), verified.indexed)
+
+    def on_tick(self, slot: int) -> None:
+        """Slot-timer entry: advance fork choice's clock and re-evaluate
+        the head, all under the chain lock (the timer runs on its own
+        thread)."""
+        with self._chain_lock:
+            self.fork_choice.on_tick(slot)
+            self._recompute_head_locked()
+
+    def on_attester_slashing(self, slashing) -> None:
+        """Record an attester slashing's equivocation evidence in fork
+        choice (HTTP-pool and gossip paths; locked — mutates proto-array
+        state)."""
+        with self._chain_lock:
+            self.fork_choice.on_attester_slashing(
+                slashing.attestation_1, slashing.attestation_2
+            )
 
     # -- head / finalization ----------------------------------------------
 
     def recompute_head(self) -> bytes:
+        with self._chain_lock:
+            return self._recompute_head_locked()
+
+    def _recompute_head_locked(self) -> bytes:
         _HEAD_RECOMPUTE.inc()
         head_root = self.fork_choice.get_head()
         if head_root != self.head_block_root:
-            self.head_block_root = head_root
             state = self.snapshot_cache.get(head_root)
             if state is None:
                 head_block = self.store.get_block(head_root)
                 state = self.store.get_state(bytes(head_block.message.state_root))
-            self.head_state = state
+            self._head = (head_root, state)  # atomic pair swap
             self.store.put_head(head_root)
         # Finalization is advanced by fork_choice.on_block, so compare
         # against the chain's own last-seen epoch, not a before/after of

@@ -41,8 +41,7 @@ def revert_to_fork_boundary(chain, fork_epoch: int) -> bytes:
         (state.finalized_checkpoint.epoch, root),
         [v.effective_balance for v in state.validators],
     )
-    chain.head_block_root = root
-    chain.head_state = state
+    chain.set_head(root, state)
     chain._last_finalized_epoch = state.finalized_checkpoint.epoch
     chain.snapshot_cache.insert(root, state)
     chain.store.put_head(root)

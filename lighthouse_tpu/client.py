@@ -254,8 +254,7 @@ def _slot_timer(chain, clock, stop: threading.Event) -> None:
         slot = clock.now()
         if slot != last:
             try:
-                chain.fork_choice.on_tick(slot)
-                chain.recompute_head()
+                chain.on_tick(slot)
             except Exception:
                 pass
             last = slot

@@ -52,6 +52,7 @@ from ..state_transition import (
     partial_state_advance,
 )
 from ..state_transition.epoch import fork_of
+from ..beacon_chain.pubkey_cache import PubkeyCacheError
 from ..types.containers import FORK_IDS as _FORK_IDS
 from ..utils import metrics
 
@@ -517,7 +518,7 @@ class BeaconApiServer:
             s = from_json(t.AttesterSlashing, body)
             if chain.op_pool is not None:
                 chain.op_pool.insert_attester_slashing(s)
-            chain.fork_choice.on_attester_slashing(s.attestation_1, s.attestation_2)
+            chain.on_attester_slashing(s)
             return None
         if path == "/eth/v1/beacon/pool/proposer_slashings" and method == "POST":
             s = from_json(t.ProposerSlashing, body)
@@ -537,6 +538,9 @@ class BeaconApiServer:
             for obj in body:
                 vi = int(obj["validator_index"])
                 slot = int(obj["slot"])
+                if not 0 <= vi < len(st.validators):
+                    rejected += 1
+                    continue
                 committee = _sync_committee_for_slot(chain, st, slot)
                 if committee is None:
                     rejected += 1
@@ -559,7 +563,7 @@ class BeaconApiServer:
                     sig = _bls.Signature.deserialize(sig_raw)
                     pk = chain.pubkey_cache.get(vi)
                     ok = sig.verify(pk, signing_root)
-                except (_bls.BlsError, Exception):
+                except (_bls.BlsError, PubkeyCacheError):
                     ok = False
                 if not ok:
                     rejected += 1

@@ -20,6 +20,8 @@ here, arranged around the batched hashing seam:
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 from .core import (
@@ -186,6 +188,12 @@ class CachedRootComputer:
     def __init__(self):
         self._trees: dict[str, MerkleTreeCache] = {}
         self._memo = _ElemRootMemo()
+        # The BeaconProcessor runs >1 worker thread; a computer shared
+        # across threads (e.g. a per-chain instance reached from HTTP and
+        # worker threads) must serialize — the diff-then-rehash in
+        # MerkleTreeCache.update is not atomic, so interleaved updates
+        # would permanently corrupt cached layers.
+        self._lock = threading.Lock()
 
     def _tree(self, key: str, depth: int) -> MerkleTreeCache:
         t = self._trees.get(key)
@@ -227,12 +235,13 @@ class CachedRootComputer:
     # -- the public entry ------------------------------------------------
 
     def hash_tree_root(self, value: Container) -> bytes:
-        tpe = type(value)
-        leaves = []
-        for name, t in tpe.fields:
-            v = getattr(value, name)
-            leaves.append(self._field_root(name, t, v))
-        return merkleize(leaves, len(leaves))
+        with self._lock:
+            tpe = type(value)
+            leaves = []
+            for name, t in tpe.fields:
+                v = getattr(value, name)
+                leaves.append(self._field_root(name, t, v))
+            return merkleize(leaves, len(leaves))
 
     def _field_root(self, name: str, t, v) -> bytes:
         if isinstance(t, List):
@@ -263,9 +272,25 @@ class CachedRootComputer:
         return hash_tree_root(t, v)
 
 
-# Default computer used by the state transition's per-slot root refresh.
-DEFAULT_STATE_ROOT_COMPUTER = CachedRootComputer()
+# Default computers for the state transition's per-slot root refresh — a
+# small LIFO POOL, not thread-local: per-thread computers would start cold
+# on every ThreadingHTTPServer request thread (a full re-merkleization per
+# request), while a single shared computer would serialize concurrent
+# state transitions AND thrash its diff trees between unrelated state
+# lineages (trees are keyed by field name). LIFO checkout keeps the
+# warmest computer with the active lineage; concurrent transitions get
+# their own.
+_POOL: list[CachedRootComputer] = []
+_POOL_CAP = 4
+_POOL_LOCK = threading.Lock()
 
 
 def cached_state_root(state) -> bytes:
-    return DEFAULT_STATE_ROOT_COMPUTER.hash_tree_root(state)
+    with _POOL_LOCK:
+        computer = _POOL.pop() if _POOL else CachedRootComputer()
+    try:
+        return computer.hash_tree_root(state)
+    finally:
+        with _POOL_LOCK:
+            if len(_POOL) < _POOL_CAP:
+                _POOL.append(computer)

@@ -11,6 +11,7 @@ standardized key-manager routes with bearer-token auth):
 
 from __future__ import annotations
 
+import hmac
 import json
 import secrets
 import threading
@@ -39,9 +40,12 @@ class KeymanagerApi:
                 pass
 
             def _auth(self) -> bool:
-                return (
-                    self.headers.get("Authorization", "")
-                    == f"Bearer {outer.token}"
+                # bytes operands: compare_digest raises TypeError on
+                # non-ASCII str, which would crash the handler
+                header = self.headers.get("Authorization", "")
+                return hmac.compare_digest(
+                    header.encode("utf-8", "surrogateescape"),
+                    f"Bearer {outer.token}".encode(),
                 )
 
             def _reply(self, code: int, obj) -> None:
