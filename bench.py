@@ -16,9 +16,14 @@ a SUBPROCESS with a deadline first; if the probe fails or times out the
 bench falls back to the CPU backend so a measurement is always printed.
 Persistent compilation cache keeps the recurring driver runs cheap.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} with
-``vs_baseline`` measured against the 50k aggregate-verifications/sec
-target from BASELINE.json (one aggregate = 3 sets).
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+``vs_baseline`` is the ratio of the measured device throughput to the
+NATIVE C CPU baseline (`_native/bls12381.c`, backend "cpu-native" — the
+blst-class baseline BASELINE.md demands) measured in-process on the SAME
+workload; ``vs_target`` tracks the 50k aggregate-verifications/sec goal
+from BASELINE.json (one aggregate = 3 sets). The line also stamps
+``backend`` ("tpu" | "cpu-fallback") and the padded bucket shapes so a
+fallback run can never masquerade as the TPU metric (VERDICT r2 weak #1).
 """
 
 from __future__ import annotations
@@ -137,6 +142,25 @@ def build_sets():
     return sets
 
 
+def measure_native_baseline(sets) -> float | None:
+    """sets/s of the native C backend on the same workload (the reference
+    seam, blst.rs:36-119, measured as BASELINE.md requires). None when no
+    C toolchain is available."""
+    try:
+        from lighthouse_tpu.crypto.native import NativeBackend
+
+        native = NativeBackend()
+    except Exception:
+        return None
+    assert native.verify_signature_sets(sets) is True
+    reps = 2
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        native.verify_signature_sets(sets)
+    dt = (time.perf_counter() - t0) / reps
+    return len(sets) / dt
+
+
 def main() -> None:
     use_cpu = not probe_tpu()
     if use_cpu:
@@ -184,13 +208,22 @@ def main() -> None:
 
     sets_per_sec = n_sets / dt
     agg_per_sec = N_AGG / dt
+
+    baseline = measure_native_baseline(sets)
     print(
         json.dumps(
             {
                 "metric": "bls_sigset_verifications_per_sec_per_chip",
                 "value": round(sets_per_sec, 2),
                 "unit": "sets/s",
-                "vs_baseline": round(agg_per_sec / TARGET_AGG_PER_SEC, 4),
+                "vs_baseline": (
+                    round(sets_per_sec / baseline, 4) if baseline else 0.0
+                ),
+                "vs_target": round(agg_per_sec / TARGET_AGG_PER_SEC, 4),
+                "backend": "cpu-fallback" if use_cpu else "tpu",
+                "baseline_backend": "cpu-native" if baseline else "unavailable",
+                "baseline_sets_per_sec": round(baseline, 2) if baseline else None,
+                "shapes": {"B": B_PAD, "K": K_PAD, "M": M_PAD, "n_sets": n_sets},
             }
         )
     )

@@ -81,8 +81,15 @@ class FakeBackend:
         return bool(sets) and all(pks for _, pks, _ in sets)
 
 
+def _native_backend():
+    from .native import NativeBackend
+
+    return NativeBackend()
+
+
 _REGISTRY: Dict[str, Callable[[], object]] = {
     "cpu": lambda: CpuBackend(),
+    "cpu-native": _native_backend,
     "fake": lambda: FakeBackend(),
 }
 
