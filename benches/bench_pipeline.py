@@ -1,13 +1,17 @@
-"""Host-pipeline benchmarks (BASELINE.md configs #3 measurement shape and
-the cached-state-root criterion from VERDICT r1 #9).
+"""Host-pipeline benchmarks (BASELINE.md config #3 end-to-end and the
+cached-state-root criterion from VERDICT r1 #9).
 
-1. Gossip pipeline: N single-bit attestations submitted to the
-   BeaconProcessor, coalesced into device-bucket batches, structurally
-   verified and applied to fork choice (fake BLS backend isolates the
-   HOST pipeline cost — the device cost is bench.py's job). Reports
-   throughput and queue-wait p50/p99 from the processor's histograms.
-2. State re-hash: full hash_tree_root vs the incremental cached root on a
-   large validator registry after a small per-slot mutation.
+1. Gossip pipeline END-TO-END (config #3): N single-bit REAL-signed
+   attestations submitted to the BeaconProcessor, coalesced into
+   device-bucket batches, signature-verified on the ``cpu-native`` C
+   backend, applied to fork choice. Reports attestations/sec and the
+   p50/p99 submit-to-verified latency (queue wait + verify together) —
+   the reference's measurement shape is ``attestation_verification/
+   batch.rs:139-222`` feeding ``beacon_processor/mod.rs:1008-1099``.
+2. Gossip pipeline HOST-ONLY: same run on the ``fake`` backend, isolating
+   scheduler/structural cost (the device cost is bench.py's job).
+3. State re-hash: full hash_tree_root vs the incremental cached root on a
+   large registry after a per-slot-shaped mutation.
 
 Run: python benches/bench_pipeline.py [n_attestations] [n_validators]
 """
@@ -23,25 +27,18 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def bench_gossip_pipeline(n_atts: int) -> dict:
-    from lighthouse_tpu.beacon_chain import (
-        BeaconChain,
-        VerifiedUnaggregatedAttestation,
-    )
-    from lighthouse_tpu.beacon_processor import BeaconProcessor, Work, WorkKind
-    from lighthouse_tpu.crypto import backend
+def _mk_bench_chain(n_validators: int):
+    from lighthouse_tpu.beacon_chain import BeaconChain
     from lighthouse_tpu.state_transition import store_replayer
     from lighthouse_tpu.store import HotColdDB, MemoryStore
     from lighthouse_tpu.testing.harness import StateHarness
     from lighthouse_tpu.types.chain_spec import minimal_spec
     from lighthouse_tpu.types.preset import MINIMAL
-    from lighthouse_tpu.utils import metrics
     from lighthouse_tpu.utils.slot_clock import ManualSlotClock
 
-    backend.set_backend("fake")
     h = StateHarness(
-        MINIMAL, minimal_spec(), validator_count=64, fork_name="phase0",
-        fake_sign=True,
+        MINIMAL, minimal_spec(), validator_count=n_validators,
+        fork_name="phase0", fake_sign=True,
     )
     genesis = copy.deepcopy(h.state)
     db = HotColdDB(MemoryStore(), h.t, h.spec, store_replayer(h.preset, h.spec))
@@ -52,10 +49,71 @@ def bench_gossip_pipeline(n_atts: int) -> dict:
     sb = h.produce_block(slot)
     h.process_block(sb, strategy="none")
     chain.process_block(chain.verify_block_for_gossip(sb))
-    clock.set_slot(slot + 1)
+    return h, chain, clock
 
-    # template attestations across committees; duplicates of distinct
-    # validators via committee positions
+
+def _real_signed_singles(h, chain, n_atts: int):
+    """Single-bit attestations with REAL signatures across as many slots
+    as needed, signed through the C library (native_sign)."""
+    from lighthouse_tpu.crypto.native import native_sign
+    from lighthouse_tpu.state_transition import (
+        CommitteeCache,
+        partial_state_advance,
+    )
+    from lighthouse_tpu.state_transition.helpers import compute_epoch_at_slot
+    from lighthouse_tpu.types.chain_spec import DOMAIN_BEACON_ATTESTER
+    from lighthouse_tpu.types.domains import compute_signing_root, get_domain
+
+    t = h.t
+    spe = h.preset.SLOTS_PER_EPOCH
+    head_root = chain.head_block_root
+    genesis_root = chain.genesis_block_root
+    epoch_caches = {}
+    singles = []
+    slot = 1
+    base = chain.head_state
+    while len(singles) < n_atts:
+        epoch = compute_epoch_at_slot(h.preset, slot)
+        if epoch not in epoch_caches:
+            st = copy.deepcopy(base)
+            if st.slot < epoch * spe:
+                st = partial_state_advance(h.preset, h.spec, st, epoch * spe)
+            epoch_caches[epoch] = (CommitteeCache(h.preset, st, epoch), st)
+        cache, st = epoch_caches[epoch]
+        # target: the newest block at/before the epoch boundary
+        target_root = genesis_root if epoch == 0 else head_root
+        domain = get_domain(h.spec, st, DOMAIN_BEACON_ATTESTER, epoch)
+        for index in range(cache.committees_per_slot):
+            committee = cache.committee(slot, index)
+            data = t.AttestationData(
+                slot=slot,
+                index=index,
+                beacon_block_root=head_root,
+                source=st.current_justified_checkpoint,
+                target=t.Checkpoint(epoch=epoch, root=target_root),
+            )
+            root = compute_signing_root(t.AttestationData, data, domain)
+            for pos, v in enumerate(committee):
+                sig = native_sign(h.keys[int(v)].k, root)
+                singles.append(
+                    t.Attestation(
+                        aggregation_bits=[
+                            p == pos for p in range(len(committee))
+                        ],
+                        data=data,
+                        signature=sig,
+                    )
+                )
+                if len(singles) >= n_atts:
+                    return singles, slot
+            if len(singles) >= n_atts:
+                return singles, slot
+        slot += 1
+    return singles, slot
+
+
+def _fake_singles(h, n_atts: int, slot: int = 1):
+    """Template duplication (host-only mode: signatures are not checked)."""
     templates = h.attestations_for_slot(h.state, slot)
     singles = []
     while len(singles) < n_atts:
@@ -69,40 +127,85 @@ def bench_gossip_pipeline(n_atts: int) -> dict:
                     break
             if len(singles) >= n_atts:
                 break
+    return singles
 
-    done = []
 
-    def on_batch(items):
-        res = chain.batch_verify_unaggregated_attestations_for_gossip(items)
-        for r in res:
-            if isinstance(r, VerifiedUnaggregatedAttestation):
-                chain.apply_attestation_to_fork_choice(r)
-        return res
+def bench_gossip_pipeline(n_atts: int, real: bool = False) -> dict:
+    from lighthouse_tpu.beacon_chain import VerifiedUnaggregatedAttestation
+    from lighthouse_tpu.beacon_processor import BeaconProcessor, Work, WorkKind
+    from lighthouse_tpu.crypto import backend
+    from lighthouse_tpu.utils import metrics
 
-    bp = BeaconProcessor({WorkKind.GOSSIP_ATTESTATION: on_batch}, n_workers=2)
-    t0 = time.perf_counter()
-    accepted = 0
-    shed = 0
-    for s in singles:
-        if bp.submit(Work(WorkKind.GOSSIP_ATTESTATION, s, done=done.append)):
-            accepted += 1
+    # Setup (block import with the harness's stamped signature) runs on
+    # the fake backend; the MEASURED attestation path switches to the
+    # real one below.
+    backend.set_backend("fake")
+    try:
+        n_validators = max(64, min(4096, n_atts)) if real else 64
+        h, chain, clock = _mk_bench_chain(n_validators)
+        if real:
+            singles, max_slot = _real_signed_singles(h, chain, n_atts)
+            clock.set_slot(max_slot + 1)
+            backend.set_backend("cpu-native")
         else:
-            shed += 1  # bounded-queue shedding: those done-callbacks never fire
-    while len(done) < accepted and time.perf_counter() - t0 < 120:
-        time.sleep(0.005)
-    dt = time.perf_counter() - t0
-    bp.shutdown()
+            singles = _fake_singles(h, n_atts)
+            clock.set_slot(2)
 
-    wait = metrics.histogram("beacon_processor_queue_wait_seconds")
-    batch = metrics.histogram("beacon_processor_batch_size")
-    return {
-        "n": len(done),
-        "shed": shed,
-        "throughput_per_sec": round(len(done) / dt, 1),
-        "queue_wait_p50_s": wait.quantile(0.5),
-        "queue_wait_p99_s": wait.quantile(0.99),
-        "mean_batch": round(batch.sum / max(1, batch.total), 1),
-    }
+        done = []
+        latencies = []
+
+        def on_batch(items):
+            res = chain.batch_verify_unaggregated_attestations_for_gossip(items)
+            for r in res:
+                if isinstance(r, VerifiedUnaggregatedAttestation):
+                    chain.apply_attestation_to_fork_choice(r)
+            return res
+
+        bp = BeaconProcessor({WorkKind.GOSSIP_ATTESTATION: on_batch}, n_workers=2)
+        t0 = time.perf_counter()
+        accepted = 0
+        shed = 0
+        for s in singles:
+            w = Work(WorkKind.GOSSIP_ATTESTATION, s)
+            sub = time.perf_counter()
+
+            def record(res, _sub=sub):
+                # submit-to-verified latency: queue wait + batch verify
+                latencies.append(time.perf_counter() - _sub)
+                done.append(res)
+
+            w.done = record
+            if bp.submit(w):
+                accepted += 1
+            else:
+                shed += 1  # bounded-queue shedding: callbacks never fire
+        while len(done) < accepted and time.perf_counter() - t0 < 300:
+            time.sleep(0.005)
+        dt = time.perf_counter() - t0
+        bp.shutdown()
+
+        n_verified = sum(
+            1 for r in done if isinstance(r, VerifiedUnaggregatedAttestation)
+        )
+        lat = sorted(latencies)
+
+        def pct(p):
+            return round(lat[min(len(lat) - 1, int(p * len(lat)))], 4) if lat else None
+
+        batch = metrics.histogram("beacon_processor_batch_size")
+        return {
+            "backend": backend.active_name(),
+            "n_submitted": len(singles),
+            "n_done": len(done),
+            "n_verified": n_verified,
+            "shed": shed,
+            "throughput_per_sec": round(len(done) / dt, 1),
+            "latency_p50_s": pct(0.50),
+            "latency_p99_s": pct(0.99),
+            "mean_batch": round(batch.sum / max(1, batch.total), 1),
+        }
+    finally:
+        backend.set_backend("cpu")
 
 
 def bench_state_rehash(n_validators: int) -> dict:
@@ -145,7 +248,8 @@ if __name__ == "__main__":
     n_atts = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
     n_vals = int(sys.argv[2]) if len(sys.argv) > 2 else 100_000
     out = {
-        "gossip_pipeline": bench_gossip_pipeline(n_atts),
+        "gossip_pipeline_e2e": bench_gossip_pipeline(n_atts, real=True),
+        "gossip_pipeline_host_only": bench_gossip_pipeline(n_atts),
         "state_rehash": bench_state_rehash(n_vals),
     }
     print(json.dumps(out, indent=2))

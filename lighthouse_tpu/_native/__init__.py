@@ -30,9 +30,24 @@ def _compiler() -> str | None:
     return None
 
 
+_LOAD_CACHE: dict[str, ctypes.CDLL | None] = {}
+
+
 def build_and_load(stem: str, extra_flags: tuple[str, ...] = ()) -> ctypes.CDLL | None:
     """Compile ``<stem>.c`` into ``lib<stem>.so`` (if stale) and dlopen it.
-    Returns None when no compiler is available or the build fails."""
+    Returns None when no compiler is available or the build fails. The
+    outcome — INCLUDING failure — is cached per stem, so hot callers with
+    a pure-Python fallback (SecretKey.sign/public_key) never re-probe the
+    compiler per call."""
+    if stem in _LOAD_CACHE:
+        return _LOAD_CACHE[stem]
+    _LOAD_CACHE[stem] = out = _build_and_load_uncached(stem, extra_flags)
+    return out
+
+
+def _build_and_load_uncached(
+    stem: str, extra_flags: tuple[str, ...] = ()
+) -> ctypes.CDLL | None:
     src = _DIR / f"{stem}.c"
     so = _DIR / f"lib{stem}{sysconfig.get_config_var('EXT_SUFFIX') or '.so'}"
     if not src.exists():

@@ -1542,6 +1542,71 @@ int bls_aggregate_verify(uint32_t n,
     return fp12_is_one(&e);
 }
 
+/* compress a G2 affine point to the 96-byte wire form */
+static void g2_compress(uint8_t out[96], const g2a *a) {
+    if (a->inf) {
+        memset(out, 0, 96);
+        out[0] = 0xC0;
+        return;
+    }
+    fp_to_bytes(out, &a->x.c1);
+    fp_to_bytes(out + 48, &a->x.c0);
+    fp2 ny;
+    fp2_neg(&ny, &a->y);
+    int larger = fp2_lex_gt(&a->y, &ny);
+    out[0] |= 0x80 | (larger ? 0x20 : 0);
+}
+
+/* sign: [sk] H(msg) -> compressed G2. sk is 32 big-endian bytes (mod r
+ * already enforced by the caller). Bench/test helper — validator signing
+ * stays host-side in production, this keeps workload generation fast. */
+int bls_sign(const uint8_t sk_be[32], const uint8_t *msg, uint32_t msg_len,
+             const uint8_t *dst, uint32_t dst_len, uint8_t out_sig[96]) {
+    ensure_init();
+    g2a h;
+    hash_to_g2(&h, msg, msg_len, dst, dst_len);
+    uint64_t e[4] = {0};
+    for (int i = 0; i < 4; i++)
+        for (int j = 0; j < 8; j++)
+            e[i] |= (uint64_t)sk_be[32 - 8 * (i + 1) + (7 - j)] << (8 * j);
+    g2p p, s;
+    g2_from_affine(&p, &h);
+    /* 256-bit double-and-add */
+    g2_set_inf(&s);
+    int started = 0;
+    for (int i = 3; i >= 0; i--)
+        for (int b = 63; b >= 0; b--) {
+            if (started) g2_dbl(&s, &s);
+            if ((e[i] >> b) & 1) {
+                if (!started) { s = p; started = 1; }
+                else g2_add(&s, &s, &p);
+            }
+        }
+    g2a sa;
+    if (!started) { sa.inf = 1; sa.x = FP2_ZERO; sa.y = FP2_ZERO; }
+    else g2_to_affine(&sa, &s);
+    g2_compress(out_sig, &sa);
+    return 1;
+}
+
+/* sk -> pubkey: [sk] G1_gen, written as raw affine x||y (96 BE bytes). */
+int bls_sk_to_pk(const uint8_t sk_be[32], uint8_t out_xy[96]) {
+    ensure_init();
+    uint64_t e[4] = {0};
+    for (int i = 0; i < 4; i++)
+        for (int j = 0; j < 8; j++)
+            e[i] |= (uint64_t)sk_be[32 - 8 * (i + 1) + (7 - j)] << (8 * j);
+    g1p g, s;
+    g1_from_affine(&g, &G1_GEN);
+    g1_mul(&s, &g, e, 4);
+    g1a a;
+    g1_to_affine(&a, &s);
+    if (a.inf) return 0;
+    fp_to_bytes(out_xy, &a.x);
+    fp_to_bytes(out_xy + 48, &a.y);
+    return 1;
+}
+
 /* debug taps for the hash-to-curve pipeline (used by tests only) */
 int bls_dbg_expand(const uint8_t *msg, uint32_t msg_len,
                    const uint8_t *dst, uint32_t dst_len, uint8_t out[256]) {

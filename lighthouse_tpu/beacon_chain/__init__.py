@@ -26,6 +26,14 @@ from .observed import (
     ObservedOperations,
 )
 from .pubkey_cache import ValidatorPubkeyCache
+from .sync_committee_verification import (
+    batch_verify_sync_committee_messages,
+    SyncCommitteeError,
+    VerifiedSyncCommitteeMessage,
+    VerifiedSyncContribution,
+    verify_sync_committee_message,
+    verify_sync_contribution,
+)
 from .validator_monitor import ValidatorMonitor
 
 __all__ = [
@@ -43,6 +51,12 @@ __all__ = [
     "ShufflingCache",
     "SignatureVerifiedBlock",
     "SnapshotCache",
+    "SyncCommitteeError",
+    "batch_verify_sync_committee_messages",
+    "VerifiedSyncCommitteeMessage",
+    "VerifiedSyncContribution",
+    "verify_sync_committee_message",
+    "verify_sync_contribution",
     "ValidatorMonitor",
     "ValidatorPubkeyCache",
     "VerifiedAggregatedAttestation",

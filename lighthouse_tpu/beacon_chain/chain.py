@@ -455,6 +455,9 @@ class BeaconChain:
         self.observed_aggregators.prune(epoch)
         self.observed_aggregates.prune(fin_slot)
         self.observed_block_producers.prune(fin_slot)
+        obs_sync = getattr(self, "observed_sync_items", None)
+        if obs_sync is not None:
+            obs_sync.prune(fin_slot)
         self.fork_choice.prune()
         block = self.store.get_block(root)
         if block is not None:

@@ -32,17 +32,21 @@ class WorkKind(enum.Enum):
     CHAIN_SEGMENT = 0
     GOSSIP_BLOCK = 1
     GOSSIP_AGGREGATE = 2
-    GOSSIP_ATTESTATION = 3
-    API_REQUEST = 4
+    GOSSIP_SYNC_CONTRIBUTION = 3
+    GOSSIP_ATTESTATION = 4
+    GOSSIP_SYNC_MESSAGE = 5
+    API_REQUEST = 6
 
 
 # Bounded queue sizes (reference mod.rs:84-105: 16_384 unagg, 4_096 agg,
-# 1_024 blocks).
+# 1_024 blocks; sync queues sized like their attestation analogues).
 DEFAULT_QUEUE_BOUNDS = {
     WorkKind.CHAIN_SEGMENT: 64,
     WorkKind.GOSSIP_BLOCK: 1_024,
     WorkKind.GOSSIP_AGGREGATE: 4_096,
+    WorkKind.GOSSIP_SYNC_CONTRIBUTION: 4_096,
     WorkKind.GOSSIP_ATTESTATION: 16_384,
+    WorkKind.GOSSIP_SYNC_MESSAGE: 16_384,
     WorkKind.API_REQUEST: 1_024,
 }
 
@@ -51,11 +55,12 @@ DEFAULT_QUEUE_BOUNDS = {
 DEFAULT_BATCH_CEILINGS = {
     WorkKind.GOSSIP_ATTESTATION: 256,
     WorkKind.GOSSIP_AGGREGATE: 64,
+    WorkKind.GOSSIP_SYNC_MESSAGE: 128,
 }
 
 # LIFO kinds (the reference drains attestations newest-first so stale
 # items shed under load).
-_LIFO = {WorkKind.GOSSIP_ATTESTATION}
+_LIFO = {WorkKind.GOSSIP_ATTESTATION, WorkKind.GOSSIP_SYNC_MESSAGE}
 
 
 @dataclass

@@ -25,7 +25,9 @@ def _add_global_flags(p: argparse.ArgumentParser) -> None:
         help="compile-time preset analogue (EthSpec selection)",
     )
     p.add_argument(
-        "--bls-backend", choices=["cpu", "fake", "tpu"], default="cpu",
+        "--bls-backend",
+        choices=["cpu", "cpu-native", "fake", "tpu"],
+        default="cpu",
         help="BLS execution backend (the TPU batch verifier is 'tpu')",
     )
     p.add_argument("--datadir", default=None)

@@ -235,10 +235,40 @@ def _build_processor(chain, n_workers: int) -> BeaconProcessor:
     def on_chain_segment(item):
         return chain.process_chain_segment(item)
 
+    def on_sync_message_batch(items):
+        from .beacon_chain.sync_committee_verification import (
+            VerifiedSyncCommitteeMessage,
+            batch_verify_sync_committee_messages,
+        )
+
+        results = batch_verify_sync_committee_messages(chain, items)
+        if chain.op_pool is not None:
+            for v in results:
+                if isinstance(v, VerifiedSyncCommitteeMessage):
+                    m = v.message
+                    for pos in v.positions:
+                        chain.op_pool.insert_sync_committee_message(
+                            int(m.slot),
+                            bytes(m.beacon_block_root),
+                            pos,
+                            bytes(m.signature),
+                        )
+        return results
+
+    def on_sync_contribution(item):
+        from .beacon_chain import verify_sync_contribution
+
+        v = verify_sync_contribution(chain, item)
+        if chain.op_pool is not None:
+            chain.op_pool.insert_sync_contribution(item.message.contribution)
+        return v
+
     return BeaconProcessor(
         {
             WorkKind.GOSSIP_ATTESTATION: on_attestation_batch,
             WorkKind.GOSSIP_AGGREGATE: on_aggregate_batch,
+            WorkKind.GOSSIP_SYNC_MESSAGE: on_sync_message_batch,
+            WorkKind.GOSSIP_SYNC_CONTRIBUTION: on_sync_contribution,
             WorkKind.GOSSIP_BLOCK: on_block,
             WorkKind.CHAIN_SEGMENT: on_chain_segment,
         },

@@ -176,6 +176,41 @@ class BeaconNodeClient:
         """messages: [{slot, beacon_block_root, validator_index, signature}]"""
         self._post("/eth/v1/beacon/pool/sync_committees", messages)
 
+    def sync_committee_contribution(
+        self, slot: int, subcommittee_index: int, beacon_block_root: bytes
+    ):
+        out = self._get(
+            "/eth/v1/validator/sync_committee_contribution",
+            {
+                "slot": slot,
+                "subcommittee_index": subcommittee_index,
+                "beacon_block_root": "0x" + bytes(beacon_block_root).hex(),
+            },
+        )
+        return from_json(self.t.SyncCommitteeContribution, out["data"])
+
+    def publish_contribution_and_proofs(self, signed_contributions) -> None:
+        self._post(
+            "/eth/v1/validator/contribution_and_proofs",
+            [
+                to_json(self.t.SignedContributionAndProof, sc)
+                for sc in signed_contributions
+            ],
+        )
+
+    def beacon_committee_subscriptions(self, subscriptions) -> None:
+        self._post("/eth/v1/validator/beacon_committee_subscriptions", subscriptions)
+
+    def sync_committee_subscriptions(self, subscriptions) -> None:
+        self._post("/eth/v1/validator/sync_committee_subscriptions", subscriptions)
+
+    def prepare_beacon_proposer(self, preparations) -> None:
+        """preparations: [{validator_index, fee_recipient}]"""
+        self._post("/eth/v1/validator/prepare_beacon_proposer", preparations)
+
+    def register_validator(self, registrations) -> None:
+        self._post("/eth/v1/validator/register_validator", registrations)
+
     def publish_aggregate_and_proofs(self, signed_aggregates) -> None:
         self._post(
             "/eth/v1/validator/aggregate_and_proofs",

@@ -572,6 +572,19 @@ class BeaconApiServer:
                     chain.op_pool.insert_sync_committee_message(
                         slot, root, pos, sig_raw
                     )
+                # propagate node->node on the per-subnet gossip topics
+                # (reference topics.rs:19-20, sync_committee_{subnet})
+                net = getattr(chain, "network", None)
+                if net is not None:
+                    msg = t.SyncCommitteeMessage(
+                        slot=slot,
+                        beacon_block_root=root,
+                        validator_index=vi,
+                        signature=sig_raw,
+                    )
+                    sub_size = chain.preset.sync_subcommittee_size
+                    for subnet in sorted({p // sub_size for p in positions}):
+                        net.publish_sync_committee_message(msg, subnet)
             if rejected:
                 raise ApiError(400, f"{rejected} sync message(s) rejected")
             return None
@@ -708,6 +721,82 @@ class BeaconApiServer:
                 chain.apply_attestation_to_fork_choice(v)
                 if chain.op_pool is not None:
                     chain.op_pool.insert_attestation(sa.message.aggregate)
+            return None
+
+        # -- sync-committee aggregation surface (reference
+        #    http_api/src/lib.rs:2375-2518) -------------------------------
+        if path == "/eth/v1/validator/sync_committee_contribution":
+            slot = int(query["slot"])
+            subc = int(query["subcommittee_index"])
+            root = bytes.fromhex(query["beacon_block_root"][2:])
+            contribution = (
+                chain.op_pool.sync_contribution_for(slot, root, subc)
+                if chain.op_pool is not None
+                else None
+            )
+            if contribution is None:
+                raise ApiError(404, "no matching sync contribution")
+            return {"data": to_json(type(contribution), contribution)}
+        if path == "/eth/v1/validator/contribution_and_proofs" and method == "POST":
+            from ..beacon_chain import (
+                SyncCommitteeError,
+                verify_sync_contribution,
+            )
+
+            failures = []
+            for obj in body:
+                sc = from_json(t.SignedContributionAndProof, obj)
+                try:
+                    verify_sync_contribution(chain, sc)
+                except SyncCommitteeError as e:
+                    # duplicates are normal between competing aggregators
+                    # of the same subcommittee — not a client error
+                    if e.kind not in (
+                        "ContributionAlreadyKnown",
+                        "AggregatorAlreadyKnown",
+                    ):
+                        failures.append(str(e))
+                    continue
+                if chain.op_pool is not None:
+                    chain.op_pool.insert_sync_contribution(sc.message.contribution)
+                net = getattr(chain, "network", None)
+                if net is not None:
+                    net.publish_sync_contribution(sc)
+            if failures:
+                raise ApiError(400, "; ".join(failures))
+            return None
+        if (
+            path == "/eth/v1/validator/beacon_committee_subscriptions"
+            and method == "POST"
+        ):
+            subs = getattr(chain, "committee_subscriptions", None)
+            if subs is None:
+                subs = chain.committee_subscriptions = []
+            subs.extend(body)
+            return None
+        if (
+            path == "/eth/v1/validator/sync_committee_subscriptions"
+            and method == "POST"
+        ):
+            subs = getattr(chain, "sync_committee_subscriptions", None)
+            if subs is None:
+                subs = chain.sync_committee_subscriptions = []
+            subs.extend(body)
+            return None
+        if path == "/eth/v1/validator/prepare_beacon_proposer" and method == "POST":
+            prep = getattr(chain, "proposer_preparations", None)
+            if prep is None:
+                prep = chain.proposer_preparations = {}
+            for obj in body:
+                prep[int(obj["validator_index"])] = obj["fee_recipient"]
+            return None
+        if path == "/eth/v1/validator/register_validator" and method == "POST":
+            regs = getattr(chain, "validator_registrations", None)
+            if regs is None:
+                regs = chain.validator_registrations = {}
+            for obj in body:
+                msg = obj.get("message", obj)
+                regs[msg["pubkey"]] = msg
             return None
 
         raise ApiError(404, f"no route for {method} {path}")

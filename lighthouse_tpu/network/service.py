@@ -51,6 +51,14 @@ class Topics:
     def attestation(self, subnet: int) -> str:
         return f"{self.prefix}/beacon_attestation_{subnet}/ssz_snappy"
 
+    def sync_committee(self, subnet: int) -> str:
+        return f"{self.prefix}/sync_committee_{subnet}/ssz_snappy"
+
+    def sync_contribution(self) -> str:
+        return (
+            f"{self.prefix}/sync_committee_contribution_and_proof/ssz_snappy"
+        )
+
     def voluntary_exit(self) -> str:
         return f"{self.prefix}/voluntary_exit/ssz_snappy"
 
@@ -140,6 +148,20 @@ class NetworkService:
             self.topics.aggregate(), type(signed_aggregate).encode(signed_aggregate)
         )
 
+    def publish_sync_committee_message(self, msg, subnet: int) -> None:
+        self._publish(
+            self.topics.sync_committee(
+                subnet % self.chain.preset.SYNC_COMMITTEE_SUBNET_COUNT
+            ),
+            type(msg).encode(msg),
+        )
+
+    def publish_sync_contribution(self, signed_contribution) -> None:
+        self._publish(
+            self.topics.sync_contribution(),
+            type(signed_contribution).encode(signed_contribution),
+        )
+
     def publish_voluntary_exit(self, signed_exit) -> None:
         self._publish(
             self.topics.voluntary_exit(), type(signed_exit).encode(signed_exit)
@@ -192,12 +214,15 @@ class NetworkService:
         for tp in self._topics_by_fork.values():
             kinds[tp.block()] = "block"
             kinds[tp.aggregate()] = "aggregate"
+            kinds[tp.sync_contribution()] = "sync_contribution"
             kinds[tp.voluntary_exit()] = "voluntary_exit"
             kinds[tp.attester_slashing()] = "attester_slashing"
             kinds[tp.proposer_slashing()] = "proposer_slashing"
         kind = kinds.get(topic)
         if kind is None and "/beacon_attestation_" in topic:
             kind = "attestation"
+        if kind is None and "/sync_committee_" in topic:
+            kind = "sync_message"
         try:
             if kind == "block":
                 fork = fork_of(self.chain.head_state)
@@ -211,6 +236,14 @@ class NetworkService:
             elif kind == "attestation":
                 att = t.Attestation.decode(payload)
                 self.processor.submit(Work(WorkKind.GOSSIP_ATTESTATION, att))
+            elif kind == "sync_message":
+                sm = t.SyncCommitteeMessage.decode(payload)
+                self.processor.submit(Work(WorkKind.GOSSIP_SYNC_MESSAGE, sm))
+            elif kind == "sync_contribution":
+                sc = t.SignedContributionAndProof.decode(payload)
+                self.processor.submit(
+                    Work(WorkKind.GOSSIP_SYNC_CONTRIBUTION, sc)
+                )
             elif kind == "voluntary_exit":
                 ex = t.SignedVoluntaryExit.decode(payload)
                 if self.chain.op_pool is not None:

@@ -54,6 +54,8 @@ class OperationPool:
         self._voluntary_exits: dict[int, object] = {}
         # (slot, block_root) -> {committee_position: signature}
         self._sync_messages: dict[tuple[int, bytes], dict[int, bytes]] = {}
+        # (slot, block_root, subcommittee) -> (bits, aggregated signature)
+        self._sync_contributions: dict[tuple, tuple[list, bytes]] = {}
 
     # -- attestations ----------------------------------------------------
 
@@ -184,27 +186,102 @@ class OperationPool:
             key = (slot, bytes(block_root))
             self._sync_messages.setdefault(key, {})[committee_position] = bytes(signature)
 
-    def sync_aggregate_for_block(self, slot: int, block_root: bytes):
-        """Best-effort SyncAggregate over collected messages for
-        (slot, root); None when empty (caller uses the empty aggregate)."""
+    def insert_sync_contribution(self, contribution) -> None:
+        """Keep the best (highest-participation) contribution per
+        (slot, root, subcommittee) — reference op-pool sync contributions
+        (``operation_pool/src/sync_aggregate_id.rs`` keying)."""
+        bits = [bool(b) for b in contribution.aggregation_bits]
+        key = (
+            int(contribution.slot),
+            bytes(contribution.beacon_block_root),
+            int(contribution.subcommittee_index),
+        )
         with self._lock:
-            msgs = self._sync_messages.get((slot, bytes(block_root)))
-            if not msgs:
-                return None
-            items = sorted(msgs.items())
+            prev = self._sync_contributions.get(key)
+            if prev is None or sum(bits) > sum(prev[0]):
+                self._sync_contributions[key] = (
+                    bits, bytes(contribution.signature)
+                )
+
+    def sync_contribution_for(self, slot: int, block_root: bytes,
+                              subcommittee_index: int):
+        """Best SyncCommitteeContribution for ONE subcommittee: the
+        aggregate of collected individual messages, or a stored
+        gossip-received contribution when it has more participation (a
+        node subscribed to the contribution topic but not this subnet has
+        only the latter). None when both are empty. (The VC aggregator's
+        GET ``sync_committee_contribution`` route.)"""
+        sub_size = self.preset.sync_subcommittee_size
+        lo = subcommittee_index * sub_size
+        key = (slot, bytes(block_root), subcommittee_index)
+        with self._lock:
+            msgs = self._sync_messages.get((slot, bytes(block_root))) or {}
+            sub = {
+                pos - lo: raw
+                for pos, raw in msgs.items()
+                if lo <= pos < lo + sub_size
+            }
+            stored = self._sync_contributions.get(key)
+        bits = [False] * sub_size
         agg = bls.AggregateSignature.infinity()
-        positions = []
-        for pos, raw in items:
+        for pos, raw in sorted(sub.items()):
+            try:
+                agg.add_assign(bls.Signature.deserialize(raw))
+            except bls.BlsError:
+                continue
+            bits[pos] = True
+        if stored is not None and sum(stored[0]) > sum(bits):
+            bits, sig_bytes = list(stored[0]), stored[1]
+        elif any(bits):
+            sig_bytes = agg.serialize()
+        else:
+            return None
+        return self.types.SyncCommitteeContribution(
+            slot=slot,
+            beacon_block_root=bytes(block_root),
+            subcommittee_index=subcommittee_index,
+            aggregation_bits=bits,
+            signature=sig_bytes,
+        )
+
+    def sync_aggregate_for_block(self, slot: int, block_root: bytes):
+        """Best-effort SyncAggregate for (slot, root): stored contributions
+        cover their subcommittees; individual messages fill positions no
+        contribution covers. None when empty (caller uses the empty
+        aggregate)."""
+        key_root = bytes(block_root)
+        with self._lock:
+            msgs = dict(self._sync_messages.get((slot, key_root)) or {})
+            contribs = {
+                k[2]: v
+                for k, v in self._sync_contributions.items()
+                if k[0] == slot and k[1] == key_root
+            }
+        if not msgs and not contribs:
+            return None
+        size = self.preset.SYNC_COMMITTEE_SIZE
+        sub_size = self.preset.sync_subcommittee_size
+        agg = bls.AggregateSignature.infinity()
+        covered: set[int] = set()
+        for subc, (bits, sig_raw) in contribs.items():
+            try:
+                agg.add_assign(bls.Signature.deserialize(sig_raw))
+            except bls.BlsError:
+                continue
+            for pos, bit in enumerate(bits):
+                if bit:
+                    covered.add(subc * sub_size + pos)
+        for pos, raw in sorted(msgs.items()):
+            if pos in covered:
+                continue  # already inside a contribution's aggregate
             try:
                 agg.add_assign(bls.Signature.deserialize(raw))
             except bls.BlsError:
                 continue  # undecodable signature: skip, never break production
-            positions.append(pos)
-        if not positions:
+            covered.add(pos)
+        if not covered:
             return None
-        size = self.preset.SYNC_COMMITTEE_SIZE
-        pos_set = set(positions)
-        bits = [p in pos_set for p in range(size)]
+        bits = [p in covered for p in range(size)]
         return self.types.SyncAggregate(
             sync_committee_bits=bits,
             sync_committee_signature=agg.serialize(),
@@ -287,4 +364,9 @@ class OperationPool:
                 k: v
                 for k, v in self._sync_messages.items()
                 if k[0] + 2 >= state.slot  # only slot-1 is ever packed
+            }
+            self._sync_contributions = {
+                k: v
+                for k, v in self._sync_contributions.items()
+                if k[0] + 2 >= state.slot
             }

@@ -84,7 +84,7 @@ class LocalNetwork:
         slot = self.h.state.slot + 1
         self.clock.set_slot(slot)
         for node in self.nodes:
-            node.chain.fork_choice.on_tick(slot)
+            node.chain.on_tick(slot)
 
         # canonical copy of the chain lives in the harness (proposer keys)
         atts = []

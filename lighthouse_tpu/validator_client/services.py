@@ -278,6 +278,61 @@ class SyncCommitteeService:
             _FAILED_DUTIES.inc()
         return published
 
+    def aggregate_and_publish(self, slot: int) -> int:
+        """Sync-committee CONTRIBUTION aggregation (reference
+        ``sync_committee_service.rs`` at slot+2/3): for every duty whose
+        selection proof makes it a subcommittee aggregator, fetch the
+        node's aggregated contribution, wrap + sign a
+        ContributionAndProof, and publish."""
+        from ..beacon_chain.sync_committee_verification import (
+            is_sync_committee_aggregator,
+        )
+
+        epoch = slot // self.preset.SLOTS_PER_EPOCH
+        duties = self.duties.get(epoch, [])
+        if not duties:
+            return 0
+        published = 0
+        try:
+            head = self.nodes.call("header", "head")
+            root = bytes.fromhex(head["root"][2:])
+            sub_size = self.preset.sync_subcommittee_size
+            signed_out = []
+            for d in duties:
+                pk = bytes.fromhex(d["pubkey"][2:])
+                positions = [
+                    int(p) for p in d["validator_sync_committee_indices"]
+                ]
+                for subc in sorted({p // sub_size for p in positions}):
+                    try:
+                        proof = self.store.sign_sync_selection_proof(
+                            pk, slot, subc
+                        )
+                    except KeyError:
+                        continue
+                    if not is_sync_committee_aggregator(self.preset, proof):
+                        continue
+                    try:
+                        contribution = self.nodes.call(
+                            "sync_committee_contribution", slot, subc, root
+                        )
+                    except BeaconNodeError:
+                        continue  # nothing collected for this subcommittee
+                    msg = self.store.t.ContributionAndProof(
+                        aggregator_index=int(d["validator_index"]),
+                        contribution=contribution,
+                        selection_proof=proof,
+                    )
+                    signed_out.append(
+                        self.store.sign_contribution_and_proof(pk, msg)
+                    )
+            if signed_out:
+                self.nodes.call("publish_contribution_and_proofs", signed_out)
+                published = len(signed_out)
+        except (BeaconNodeError, SlashingProtectionError, KeyError):
+            _FAILED_DUTIES.inc()
+        return published
+
 
 class BlockService:
     """Proposal flow: randao -> produce -> sign -> publish (reference
@@ -359,6 +414,9 @@ class ValidatorClient:
         self.attestations = AttestationService(store, nodes, self.duties, types)
         self.blocks = BlockService(store, nodes, self.duties, preset)
         self.sync_committee = SyncCommitteeService(store, nodes, preset)
+        from .preparation_service import PreparationService
+
+        self.preparation = PreparationService(store, nodes, preset)
         self._stop = threading.Event()
 
     def on_slot(self, slot: int) -> None:
@@ -374,10 +432,15 @@ class ValidatorClient:
         except BeaconNodeError:
             _FAILED_DUTIES.inc()
             return
+        try:
+            self.preparation.prepare_proposers(epoch)
+        except BeaconNodeError:
+            _FAILED_DUTIES.inc()
         self.blocks.propose(slot)
         self.attestations.attest(slot)
         self.attestations.aggregate(slot)
         self.sync_committee.sign_and_publish(slot)
+        self.sync_committee.aggregate_and_publish(slot)
 
     def run_forever(self) -> None:
         while not self._stop.is_set():

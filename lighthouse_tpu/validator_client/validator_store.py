@@ -16,9 +16,11 @@ from ..types.chain_spec import (
     DOMAIN_AGGREGATE_AND_PROOF,
     DOMAIN_BEACON_ATTESTER,
     DOMAIN_BEACON_PROPOSER,
+    DOMAIN_CONTRIBUTION_AND_PROOF,
     DOMAIN_RANDAO,
     DOMAIN_SELECTION_PROOF,
     DOMAIN_SYNC_COMMITTEE,
+    DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF,
     DOMAIN_VOLUNTARY_EXIT,
 )
 from ..types.domains import compute_domain, compute_signing_root
@@ -188,6 +190,30 @@ class ValidatorStore:
         domain = self._domain(DOMAIN_SYNC_COMMITTEE, epoch)
         root = compute_signing_root(None, bytes(block_root), domain)
         return self._sign(pubkey, root)
+
+    def sign_sync_selection_proof(
+        self, pubkey: bytes, slot: int, subcommittee_index: int
+    ) -> bytes:
+        """Selection proof over SyncAggregatorSelectionData (reference
+        ``sync_committee_service.rs`` aggregation duty)."""
+        epoch = slot // self.preset.SLOTS_PER_EPOCH
+        domain = self._domain(DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF, epoch)
+        data = self.t.SyncAggregatorSelectionData(
+            slot=slot, subcommittee_index=subcommittee_index
+        )
+        root = compute_signing_root(
+            self.t.SyncAggregatorSelectionData, data, domain
+        )
+        return self._sign(pubkey, root)
+
+    def sign_contribution_and_proof(self, pubkey: bytes, message):
+        """Sign a ContributionAndProof -> SignedContributionAndProof."""
+        epoch = int(message.contribution.slot) // self.preset.SLOTS_PER_EPOCH
+        domain = self._domain(DOMAIN_CONTRIBUTION_AND_PROOF, epoch)
+        root = compute_signing_root(self.t.ContributionAndProof, message, domain)
+        return self.t.SignedContributionAndProof(
+            message=message, signature=self._sign(pubkey, root)
+        )
 
     def sign_voluntary_exit(self, pubkey: bytes, exit_msg):
         domain = self._domain(DOMAIN_VOLUNTARY_EXIT, exit_msg.epoch)

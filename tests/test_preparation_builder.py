@@ -1,0 +1,132 @@
+"""Fee-recipient preparation service + MEV builder client.
+
+Reference analogues: ``validator_client/src/preparation_service.rs`` and
+``beacon_node/builder_client/src/lib.rs`` (+ its mock builder test rig).
+VERDICT r2 missing #7.
+"""
+
+import copy
+
+import pytest
+
+from lighthouse_tpu.beacon_chain import BeaconChain
+from lighthouse_tpu.crypto import backend
+from lighthouse_tpu.eth2_client import BeaconNodeClient
+from lighthouse_tpu.execution_layer.builder_client import (
+    BuilderError,
+    BuilderHttpClient,
+    MockBuilder,
+)
+from lighthouse_tpu.http_api import BeaconApiServer
+from lighthouse_tpu.operation_pool import OperationPool
+from lighthouse_tpu.state_transition import interop_secret_key, store_replayer
+from lighthouse_tpu.store import HotColdDB, MemoryStore
+from lighthouse_tpu.testing.harness import StateHarness
+from lighthouse_tpu.types.chain_spec import minimal_spec
+from lighthouse_tpu.types.preset import MINIMAL
+from lighthouse_tpu.utils.slot_clock import ManualSlotClock
+from lighthouse_tpu.validator_client import (
+    BeaconNodeFallback,
+    ValidatorClient,
+    ValidatorStore,
+)
+
+
+@pytest.fixture(autouse=True)
+def fake_backend():
+    backend.set_backend("fake")
+    yield
+    backend.set_backend("cpu")
+
+
+def _api_chain():
+    h = StateHarness(
+        MINIMAL, minimal_spec(), validator_count=4, fork_name="phase0",
+        fake_sign=True,
+    )
+    genesis = copy.deepcopy(h.state)
+    db = HotColdDB(MemoryStore(), h.t, h.spec, store_replayer(h.preset, h.spec))
+    clock = ManualSlotClock(genesis.genesis_time, h.spec.seconds_per_slot)
+    chain = BeaconChain(h.preset, h.spec, h.t, db, genesis, slot_clock=clock)
+    chain.op_pool = OperationPool(h.preset, h.spec, h.t)
+    return h, chain, clock, genesis
+
+
+def test_preparation_service_sends_fee_recipients():
+    h, chain, clock, genesis = _api_chain()
+    api = BeaconApiServer(chain, port=0).start()
+    try:
+        c = BeaconNodeClient(f"http://127.0.0.1:{api.port}", h.t)
+        store = ValidatorStore(
+            h.spec, h.preset, h.t,
+            genesis_validators_root=bytes(genesis.genesis_validators_root),
+        )
+        for i in range(4):
+            store.add_secret_key(interop_secret_key(i))
+        vc = ValidatorClient(store, BeaconNodeFallback([c]), h.t, h.preset, clock)
+        vc.preparation.fee_recipient = b"\xaa" * 20
+        clock.set_slot(1)
+        vc.on_slot(1)  # polls duties (resolves indices) then prepares
+        prep = getattr(chain, "proposer_preparations", {})
+        assert len(prep) == 4
+        assert set(prep.values()) == {"0x" + "aa" * 20}
+        # idempotent within the epoch
+        assert vc.preparation.prepare_proposers(0) == 0
+    finally:
+        api.stop()
+
+
+def test_builder_registration_via_bn_route():
+    h, chain, clock, genesis = _api_chain()
+    api = BeaconApiServer(chain, port=0).start()
+    try:
+        c = BeaconNodeClient(f"http://127.0.0.1:{api.port}", h.t)
+        store = ValidatorStore(
+            h.spec, h.preset, h.t,
+            genesis_validators_root=bytes(genesis.genesis_validators_root),
+        )
+        for i in range(4):
+            store.add_secret_key(interop_secret_key(i))
+        vc = ValidatorClient(store, BeaconNodeFallback([c]), h.t, h.preset, clock)
+        n = vc.preparation.register_validators()
+        assert n == 4
+        regs = getattr(chain, "validator_registrations", {})
+        assert len(regs) == 4
+        for pk_hex, msg in regs.items():
+            assert msg["pubkey"] == pk_hex
+            assert msg["gas_limit"] == "30000000"
+    finally:
+        api.stop()
+
+
+def test_builder_client_against_mock():
+    mock = MockBuilder(port=0).start()
+    try:
+        client = BuilderHttpClient(mock.url)
+        assert client.status() is True
+        regs = [
+            {
+                "message": {
+                    "fee_recipient": "0x" + "bb" * 20,
+                    "gas_limit": "30000000",
+                    "timestamp": "1",
+                    "pubkey": "0x" + "cc" * 48,
+                },
+                "signature": "0x" + "00" * 96,
+            }
+        ]
+        client.register_validators(regs)
+        assert "0x" + "cc" * 48 in mock.registrations
+
+        bid = client.get_header(7, b"\x11" * 32, b"\xcc" * 48)
+        assert bid["message"]["value"] == str(10**18)
+        assert mock.headers_served[0][0] == 7
+
+        out = client.submit_blinded_block({"signed": "blinded"})
+        assert out == {"unblinded": True}
+        assert mock.submitted == [{"signed": "blinded"}]
+
+        with pytest.raises(BuilderError):
+            client._req("GET", "/eth/v1/builder/nope")
+    finally:
+        mock.stop()

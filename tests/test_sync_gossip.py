@@ -1,0 +1,310 @@
+"""Sync-committee gossip: per-subnet topics, contribution topic, node-to-
+node propagation, and the VC aggregation surface.
+
+Reference analogues: ``lighthouse_network/src/types/topics.rs:19-20,65-73``
+(the sync_committee_{subnet} / sync_committee_contribution_and_proof
+topics) and ``http_api/src/lib.rs:2375-2518`` (the validator aggregation
+routes). VERDICT r2 missing #4/#5.
+"""
+
+import copy
+import time
+import urllib.request
+
+import pytest
+
+from lighthouse_tpu.beacon_chain import (
+    SyncCommitteeError,
+    verify_sync_committee_message,
+    verify_sync_contribution,
+)
+from lighthouse_tpu.crypto import backend, bls
+from lighthouse_tpu.eth2_client import BeaconNodeClient
+from lighthouse_tpu.http_api import BeaconApiServer
+from lighthouse_tpu.state_transition import interop_secret_key
+from lighthouse_tpu.testing.simulator import LocalNetwork
+from lighthouse_tpu.types.chain_spec import (
+    DOMAIN_SYNC_COMMITTEE,
+    minimal_spec,
+)
+from lighthouse_tpu.types.domains import compute_signing_root, get_domain
+from lighthouse_tpu.validator_client import (
+    BeaconNodeFallback,
+    ValidatorClient,
+    ValidatorStore,
+)
+
+
+@pytest.fixture(autouse=True)
+def fake_backend():
+    # The simulator's blocks are fake-signed; propagation/topology is what
+    # these tests exercise. Real sync-committee signature math runs in
+    # test_sync_verification_real_crypto below.
+    backend.set_backend("fake")
+    yield
+    backend.set_backend("cpu")
+
+
+def _signed_sync_message(net, vi: int, slot: int):
+    chain = net.nodes[0].chain
+    root = chain.head_block_root
+    state = chain.head_state
+    epoch = slot // net.h.preset.SLOTS_PER_EPOCH
+    domain = get_domain(net.h.spec, state, DOMAIN_SYNC_COMMITTEE, epoch)
+    signing_root = compute_signing_root(None, root, domain)
+    sig = interop_secret_key(vi).sign(signing_root)
+    return net.h.t.SyncCommitteeMessage(
+        slot=slot,
+        beacon_block_root=root,
+        validator_index=vi,
+        signature=sig.serialize(),
+    )
+
+
+def test_sync_messages_propagate_over_gossip():
+    """A verified sync message published on its subnet topic reaches the
+    other node's pool via the BeaconProcessor."""
+    net = LocalNetwork(2, validator_count=8, fork="altair")
+    try:
+        net.tick_slot(attest=False)
+        n0, n1 = net.nodes
+        slot = net.h.state.slot
+        msg = _signed_sync_message(net, 0, slot)
+        v = verify_sync_committee_message(n0.chain, msg)
+        assert v.positions  # validator 0 holds >= 1 committee slot
+        for pos in v.positions:
+            n0.chain.op_pool.insert_sync_committee_message(
+                slot, bytes(msg.beacon_block_root), pos, bytes(msg.signature)
+            )
+        sub_size = net.h.preset.sync_subcommittee_size
+        for subnet in sorted({p // sub_size for p in v.positions}):
+            n0.net.publish_sync_committee_message(msg, subnet)
+        net._settle()
+        # node 1 received, verified, and pooled the message
+        deadline = time.time() + 5
+        agg = None
+        while time.time() < deadline:
+            agg = n1.chain.op_pool.sync_aggregate_for_block(
+                slot, bytes(msg.beacon_block_root)
+            )
+            if agg is not None:
+                break
+            time.sleep(0.05)
+        assert agg is not None, "sync message did not propagate"
+        assert sum(agg.sync_committee_bits) >= len(v.positions)
+        # duplicate is rejected on the receiving node
+        with pytest.raises(SyncCommitteeError):
+            verify_sync_committee_message(n1.chain, msg)
+    finally:
+        net.close()
+
+
+def test_vc_aggregates_and_contribution_propagates():
+    """Full aggregation surface: VC signs messages, detects aggregator
+    duty, fetches the node's contribution, publishes a signed
+    ContributionAndProof — which then propagates to the second node over
+    the contribution topic."""
+    net = LocalNetwork(2, validator_count=8, fork="altair")
+    api = BeaconApiServer(net.nodes[0].chain, port=0).start()
+    # the API publishes accepted messages/contributions to the mesh
+    net.nodes[0].chain.network = net.nodes[0].net
+    try:
+        net.tick_slot(attest=False)
+        slot = net.h.state.slot
+        net.clock.set_slot(slot)
+
+        c = BeaconNodeClient(f"http://127.0.0.1:{api.port}", net.h.t)
+        store = ValidatorStore(
+            net.h.spec, net.h.preset, net.h.t,
+            genesis_validators_root=bytes(
+                net.genesis.genesis_validators_root
+            ),
+        )
+        for i in range(8):
+            store.add_secret_key(interop_secret_key(i))
+        vc = ValidatorClient(
+            store, BeaconNodeFallback([c]), net.h.t, net.h.preset, net.clock
+        )
+        epoch = slot // net.h.preset.SLOTS_PER_EPOCH
+        vc.duties.poll_epoch(epoch)  # resolves validator indices
+        vc.sync_committee.poll_epoch(epoch)
+        assert vc.sync_committee.sign_and_publish(slot) > 0
+        assert vc.sync_committee.aggregate_and_publish(slot) > 0
+
+        # the contribution reached node 1 over gossip and was pooled
+        net._settle()
+        root = net.nodes[0].chain.head_block_root
+        deadline = time.time() + 5
+        found = None
+        while time.time() < deadline:
+            found = net.nodes[1].chain.op_pool.sync_contribution_for(
+                slot, root, 0
+            ) or next(
+                (
+                    net.nodes[1].chain.op_pool._sync_contributions.get(k)
+                    for k in list(
+                        net.nodes[1].chain.op_pool._sync_contributions
+                    )
+                ),
+                None,
+            )
+            if found is not None:
+                break
+            time.sleep(0.05)
+        assert found is not None, "contribution did not propagate"
+        # and node 0's pool can pack a sync aggregate from it
+        agg = net.nodes[0].chain.op_pool.sync_aggregate_for_block(slot, root)
+        assert agg is not None and sum(agg.sync_committee_bits) > 0
+    finally:
+        api.stop()
+        net.close()
+
+
+def test_sync_verification_real_crypto():
+    """Message + contribution verification with REAL signatures on the
+    native backend (cpu-native; falls back to the oracle backend when no
+    compiler exists). The chain itself is built under the fake backend —
+    only the sync-committee verifiers run real math here."""
+    net = LocalNetwork(1, validator_count=8, fork="altair")
+    try:
+        net.tick_slot(attest=False)
+        chain = net.nodes[0].chain
+        t = net.h.t
+        P = net.h.preset
+        slot = net.h.state.slot
+        try:
+            backend.set_backend("cpu-native")
+        except Exception:
+            backend.set_backend("cpu")
+
+        msg = _signed_sync_message(net, 1, slot)
+        v = verify_sync_committee_message(chain, msg)
+        assert v.positions
+
+        # corrupt signature must be rejected
+        bad_raw = bytearray(bytes(msg.signature))
+        bad_raw[60] ^= 1
+        bad = t.SyncCommitteeMessage(
+            slot=slot,
+            beacon_block_root=bytes(msg.beacon_block_root),
+            validator_index=2,
+            signature=bytes(bad_raw),
+        )
+        with pytest.raises(SyncCommitteeError) as e:
+            verify_sync_committee_message(chain, bad)
+        assert e.value.kind == "InvalidSignature"
+
+        # a real aggregator's contribution round-trips the verifier
+        from lighthouse_tpu.beacon_chain.sync_committee_verification import (
+            is_sync_committee_aggregator,
+            sync_committee_pubkeys,
+        )
+        from lighthouse_tpu.types.chain_spec import (
+            DOMAIN_CONTRIBUTION_AND_PROOF,
+            DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF,
+        )
+
+        state = chain.head_state
+        committee = sync_committee_pubkeys(chain, slot)
+        sub_size = P.sync_subcommittee_size
+        root = chain.head_block_root
+        epoch = slot // P.SLOTS_PER_EPOCH
+        sc_domain = get_domain(net.h.spec, state, DOMAIN_SYNC_COMMITTEE, epoch)
+        sc_root = compute_signing_root(None, root, sc_domain)
+        subc = 0
+        # participants: committee positions 0..sub_size-1 map to validators
+        bits = []
+        agg = bls.AggregateSignature.infinity()
+        by_pk = {bytes(v.pubkey): i for i, v in enumerate(state.validators)}
+        for pos in range(sub_size):
+            vi = by_pk[committee[subc * sub_size + pos]]
+            agg.add_assign(interop_secret_key(vi).sign(sc_root))
+            bits.append(True)
+        aggregator_vi = by_pk[committee[0]]
+        sel_domain = get_domain(
+            net.h.spec, state, DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF, epoch
+        )
+        sel_data = t.SyncAggregatorSelectionData(
+            slot=slot, subcommittee_index=subc
+        )
+        sel_root = compute_signing_root(
+            t.SyncAggregatorSelectionData, sel_data, sel_domain
+        )
+        proof = interop_secret_key(aggregator_vi).sign(sel_root).serialize()
+        assert is_sync_committee_aggregator(P, proof)  # modulo 1 on minimal
+        contribution = t.SyncCommitteeContribution(
+            slot=slot,
+            beacon_block_root=root,
+            subcommittee_index=subc,
+            aggregation_bits=bits,
+            signature=agg.serialize(),
+        )
+        cap = t.ContributionAndProof(
+            aggregator_index=aggregator_vi,
+            contribution=contribution,
+            selection_proof=proof,
+        )
+        cap_domain = get_domain(
+            net.h.spec, state, DOMAIN_CONTRIBUTION_AND_PROOF, epoch
+        )
+        cap_root = compute_signing_root(t.ContributionAndProof, cap, cap_domain)
+        signed = t.SignedContributionAndProof(
+            message=cap,
+            signature=interop_secret_key(aggregator_vi).sign(cap_root).serialize(),
+        )
+        vc = verify_sync_contribution(chain, signed)
+        assert len(vc.participant_indices) == sub_size
+
+        # tampered contribution signature fails
+        raw = bytearray(agg.serialize())
+        raw[60] ^= 1
+        bad_contribution = t.SyncCommitteeContribution(
+            slot=slot,
+            beacon_block_root=root,
+            subcommittee_index=1,
+            aggregation_bits=bits,
+            signature=bytes(raw),
+        )
+        cap2 = t.ContributionAndProof(
+            aggregator_index=aggregator_vi,
+            contribution=bad_contribution,
+            selection_proof=proof,
+        )
+        signed2 = t.SignedContributionAndProof(
+            message=cap2,
+            signature=interop_secret_key(aggregator_vi).sign(cap_root).serialize(),
+        )
+        with pytest.raises(SyncCommitteeError):
+            verify_sync_contribution(chain, signed2)
+    finally:
+        backend.set_backend("fake")
+        net.close()
+
+
+def test_contribution_verification_rejects_bad_inputs():
+    net = LocalNetwork(1, validator_count=8, fork="altair")
+    try:
+        net.tick_slot(attest=False)
+        chain = net.nodes[0].chain
+        slot = net.h.state.slot
+        t = net.h.t
+        bad = t.SignedContributionAndProof(
+            message=t.ContributionAndProof(
+                aggregator_index=0,
+                contribution=t.SyncCommitteeContribution(
+                    slot=slot,
+                    beacon_block_root=chain.head_block_root,
+                    subcommittee_index=99,  # out of range
+                    aggregation_bits=[True]
+                    * net.h.preset.sync_subcommittee_size,
+                    signature=bls.INFINITY_SIGNATURE,
+                ),
+                selection_proof=bls.INFINITY_SIGNATURE,
+            ),
+            signature=bls.INFINITY_SIGNATURE,
+        )
+        with pytest.raises(SyncCommitteeError) as e:
+            verify_sync_contribution(chain, bad)
+        assert e.value.kind == "InvalidSubcommittee"
+    finally:
+        net.close()
