@@ -30,6 +30,7 @@ from ..ssz import hash_tree_root
 from ..state_transition.epoch import fork_of
 from ..types.domains import compute_fork_digest
 from ..utils import metrics
+from .peer_manager import PeerManager
 from .transport import Peer, Transport
 
 _GOSSIP_RX = metrics.counter("network_gossip_received_total")
@@ -106,6 +107,8 @@ class NetworkService:
         self.transport.on_gossip = self._on_gossip
         self.transport.on_request = self._on_request
         self.transport.on_peer_connected = self._on_peer_connected
+        self.peer_manager = PeerManager()
+        self.peer_manager.on_disconnect = lambda p: p.close()
         self._seen: dict[bytes, float] = {}  # gossip message-id dedup
         self._seen_lock = threading.Lock()
         self.sync = RangeSync(self)
@@ -127,6 +130,8 @@ class NetworkService:
         return self.transport.port
 
     def connect(self, host: str, port: int) -> Optional[Peer]:
+        if self.peer_manager.is_banned(host):
+            return None
         return self.transport.dial(host, port)
 
     def close(self) -> None:
@@ -204,7 +209,46 @@ class NetworkService:
                 }
             return False
 
+    # Verification-failure kinds that are NOT the sender's fault (clock
+    # skew, duplicates seen first from another peer, not-yet-synced heads)
+    _BENIGN_KINDS = frozenset(
+        {
+            "PriorAttestationKnown",
+            "AttestationAlreadyKnown",
+            "AggregatorAlreadyKnown",
+            "ContributionAlreadyKnown",
+            "PriorMessageKnown",
+            "OutsideSlotRange",
+            "OutsideSlotWindow",
+            "UnknownHeadBlock",
+            "UnknownTargetRoot",
+            "UnknownSyncCommittee",
+            "ParentUnknown",
+            "BlockIsAlreadyKnown",
+            "RepeatProposal",
+            "FutureSlot",
+        }
+    )
+
+    def _feedback(self, peer: Peer):
+        """Done-callback reporting invalid gossip back to the scorer
+        (reference: the processor's invalid-message penalties feeding
+        gossipsub peer scores)."""
+
+        def done(result):
+            kind = getattr(result, "kind", None)
+            if (
+                isinstance(result, Exception)
+                and kind is not None
+                and kind not in self._BENIGN_KINDS
+            ):
+                self.peer_manager.report(peer, "invalid_message")
+
+        return done
+
     def _on_gossip(self, peer: Peer, topic: str, payload: bytes) -> None:
+        if not self.peer_manager.allow_gossip(peer):
+            return  # rate-limited: dropped, not forwarded
         if self._mark_seen(topic, payload):
             return
         _GOSSIP_RX.inc()
@@ -223,26 +267,38 @@ class NetworkService:
             kind = "attestation"
         if kind is None and "/sync_committee_" in topic:
             kind = "sync_message"
+        fb = self._feedback(peer)
         try:
             if kind == "block":
                 fork = fork_of(self.chain.head_state)
                 sb = t.signed_block[fork].decode(payload)
+
+                def block_done(result, _fb=fb):
+                    _fb(result)
+                    self._after_block(result)
+
                 self.processor.submit(
-                    Work(WorkKind.GOSSIP_BLOCK, sb, done=self._after_block)
+                    Work(WorkKind.GOSSIP_BLOCK, sb, done=block_done)
                 )
             elif kind == "aggregate":
                 sa = t.SignedAggregateAndProof.decode(payload)
-                self.processor.submit(Work(WorkKind.GOSSIP_AGGREGATE, sa))
+                self.processor.submit(
+                    Work(WorkKind.GOSSIP_AGGREGATE, sa, done=fb)
+                )
             elif kind == "attestation":
                 att = t.Attestation.decode(payload)
-                self.processor.submit(Work(WorkKind.GOSSIP_ATTESTATION, att))
+                self.processor.submit(
+                    Work(WorkKind.GOSSIP_ATTESTATION, att, done=fb)
+                )
             elif kind == "sync_message":
                 sm = t.SyncCommitteeMessage.decode(payload)
-                self.processor.submit(Work(WorkKind.GOSSIP_SYNC_MESSAGE, sm))
+                self.processor.submit(
+                    Work(WorkKind.GOSSIP_SYNC_MESSAGE, sm, done=fb)
+                )
             elif kind == "sync_contribution":
                 sc = t.SignedContributionAndProof.decode(payload)
                 self.processor.submit(
-                    Work(WorkKind.GOSSIP_SYNC_CONTRIBUTION, sc)
+                    Work(WorkKind.GOSSIP_SYNC_CONTRIBUTION, sc, done=fb)
                 )
             elif kind == "voluntary_exit":
                 ex = t.SignedVoluntaryExit.decode(payload)
@@ -259,7 +315,8 @@ class NetworkService:
             else:
                 return
         except Exception:
-            return  # undecodable gossip: drop (scoring would penalize)
+            self.peer_manager.report(peer, "undecodable")
+            return
         # forward to the mesh (flood-publish, minus the sender)
         self.transport.publish(topic, payload, exclude=peer)
 
@@ -273,6 +330,9 @@ class NetworkService:
     # -- req/resp --------------------------------------------------------
 
     def _on_peer_connected(self, peer: Peer) -> None:
+        if self.peer_manager.is_banned(self.peer_manager.ban_key(peer)):
+            peer.close()
+            return
         # handshake: status + peer exchange, off-thread (dial returns fast)
         threading.Thread(
             target=self._handshake, args=(peer,), daemon=True
@@ -310,6 +370,8 @@ class NetworkService:
         }
 
     def _on_request(self, peer: Peer, protocol: str, payload: bytes) -> bytes:
+        if not self.peer_manager.allow_request(peer, protocol):
+            return b""  # rate-limited (reference rpc/rate_limiter.rs)
         chain = self.chain
         if protocol == PROTO_STATUS:
             try:

@@ -21,6 +21,7 @@ from __future__ import annotations
 import socket
 import struct
 import threading
+import time
 from dataclasses import dataclass
 from typing import Callable, Optional
 
@@ -170,6 +171,9 @@ class Transport:
                     return p
         try:
             sock = socket.create_connection((host, port), timeout=5)
+            # the connect timeout must not linger: it would turn any 5s
+            # idle period into a recv timeout that kills the connection
+            sock.settimeout(None)
         except OSError:
             return None
         peer = self._add_peer(sock, (host, port))
@@ -182,9 +186,18 @@ class Transport:
             try:
                 sock, addr = self._server.accept()
             except OSError:
-                return
+                if not self._running:
+                    return
+                # transient accept error (e.g. ECONNABORTED from a reset
+                # queued connection) must not kill the listener; back off
+                # so persistent errors (fd exhaustion) cannot busy-spin
+                time.sleep(0.05)
+                continue
             peer = self._add_peer(sock, addr)
-            self.on_peer_connected(peer)
+            try:
+                self.on_peer_connected(peer)
+            except Exception:
+                peer.close()  # a handler bug must not kill the accept loop
 
     def _add_peer(self, sock: socket.socket, addr) -> Peer:
         peer = Peer(sock, addr, self._dispatch, self._remove_peer)

@@ -1,0 +1,134 @@
+"""Peer scoring + rate limiting (reference:
+``gossipsub_scoring_parameters.rs:56-83``, ``rpc/rate_limiter.rs:59``).
+VERDICT r2 next-round item #8: a flooding/invalid peer gets banned.
+"""
+
+import time
+
+import pytest
+
+from lighthouse_tpu.crypto import backend
+from lighthouse_tpu.network.peer_manager import (
+    BAN_THRESHOLD,
+    DISCONNECT_THRESHOLD,
+    PeerManager,
+    TokenBucket,
+)
+from lighthouse_tpu.network.service import PROTO_BLOCKS_BY_RANGE
+from lighthouse_tpu.network.transport import Transport
+from lighthouse_tpu.testing.simulator import LocalNetwork
+
+
+@pytest.fixture(autouse=True)
+def fake_backend():
+    backend.set_backend("fake")
+    yield
+    backend.set_backend("cpu")
+
+
+class _FakePeer:
+    def __init__(self, host="10.0.0.1", port=9):
+        self.addr = (host, port)
+        self.remote_listen_port = port
+        self.closed_by_manager = False
+
+    def close(self):
+        self.closed_by_manager = True
+
+
+def test_token_bucket_refills():
+    b = TokenBucket(capacity=2, rate=1000.0)
+    assert b.allow() and b.allow()
+    assert not b.allow()
+    time.sleep(0.01)
+    assert b.allow()  # refilled
+
+
+def test_scores_decay_and_thresholds():
+    pm = PeerManager()
+    peer = _FakePeer()
+    pm.on_disconnect = lambda p: p.close()
+    # invalid messages push the score below the disconnect threshold
+    n = int(abs(DISCONNECT_THRESHOLD) // 10) + 1
+    for _ in range(n):
+        pm.report(peer, "invalid_message")
+    assert pm.score(peer) <= DISCONNECT_THRESHOLD
+    assert peer.closed_by_manager
+    # keep offending -> ban (identity = remote IP)
+    while pm.score(peer) > BAN_THRESHOLD:
+        pm.report(peer, "invalid_message")
+    assert pm.is_banned("10.0.0.1")
+    assert not pm.is_banned("10.0.0.2")
+
+
+def test_rpc_rate_limit_and_gossip_flood():
+    pm = PeerManager(quotas={"blocks_by_range": (2, 0.001), "default": (100, 10.0)})
+    peer = _FakePeer()
+    assert pm.allow_request(peer, PROTO_BLOCKS_BY_RANGE)
+    assert pm.allow_request(peer, PROTO_BLOCKS_BY_RANGE)
+    assert not pm.allow_request(peer, PROTO_BLOCKS_BY_RANGE)  # bucket dry
+    assert pm.score(peer) < 0  # penalized
+    # gossip flood: default 512-burst bucket dries up
+    flood_peer = _FakePeer("10.0.0.3")
+    allowed = sum(1 for _ in range(1000) if pm.allow_gossip(flood_peer))
+    assert allowed < 1000
+
+
+def test_invalid_gossip_peer_gets_banned_in_simulator():
+    """An attacker transport floods node 0 with undecodable blocks: the
+    node disconnects it; on reconnect the decayed score resumes (address
+    identity) and the second flood crosses the ban threshold, after which
+    new connections from the attacker host are refused. The honest mesh
+    stays up throughout."""
+    from lighthouse_tpu.network.transport import KIND_GOSSIP
+
+    net = LocalNetwork(2, validator_count=8)
+    attacker = Transport()
+    try:
+        net.tick_slot(attest=False)
+        victim = net.nodes[0]
+
+        def flood(tag: bytes):
+            pa = attacker.dial("127.0.0.1", victim.net.port)
+            assert pa is not None
+            topic = victim.net.topics.block()
+            for i in range(30):
+                pa.send(
+                    KIND_GOSSIP,
+                    topic.encode(),
+                    b"\xde\xad" + tag + i.to_bytes(4, "big"),
+                )
+            # the victim disconnects mid-flood once the score crosses
+            # the threshold
+            deadline = time.time() + 5
+            while time.time() < deadline and not pa.closed:
+                time.sleep(0.05)
+            return pa
+
+        pa1 = flood(b"\x01")
+        assert pa1.closed  # disconnected
+        assert not victim.net.peer_manager.is_banned("127.0.0.1")
+        # each reconnect resumes the decayed score under the address key;
+        # repeat offending accumulates down to the ban threshold
+        for round_no in range(2, 12):
+            if victim.net.peer_manager.is_banned("127.0.0.1"):
+                break
+            pa = flood(bytes([round_no]))
+            assert pa.closed
+        assert victim.net.peer_manager.is_banned("127.0.0.1")
+        # a fresh connection from the banned host is refused: the victim
+        # closes it on accept. EOF delivery to an idle reader can lag, so
+        # probe with sends — a write after the remote FIN/RST surfaces
+        # the closure deterministically.
+        pa3 = attacker.dial("127.0.0.1", victim.net.port)
+        if pa3 is not None:
+            deadline = time.time() + 5
+            while time.time() < deadline and not pa3.closed:
+                pa3.send(KIND_GOSSIP, b"/probe", b"x")
+                time.sleep(0.1)
+            assert pa3.closed
+        # honest mesh is intact: the other node is still connected
+        assert victim.net.transport.peer_count() >= 1
+    finally:
+        attacker.close()
+        net.close()
