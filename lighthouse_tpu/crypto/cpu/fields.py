@@ -3,9 +3,12 @@
 Tower: Fq2 = Fq[u]/(u^2+1); Fq6 = Fq2[v]/(v^3 - xi), xi = 1+u;
 Fq12 = Fq6[w]/(w^2 - v).
 
-Plain (non-Montgomery) arithmetic — this is the host oracle; the device
-stack (``lighthouse_tpu.crypto.device``) uses Montgomery limb arithmetic
-and is tested for bit-equality against this module.
+Plain (non-Montgomery) arithmetic over Python ints — this is the host
+oracle. The DEVICE stack (``lighthouse_tpu.crypto.device``) uses 12-bit
+limb arithmetic with fold-table reduction (explicitly NOT Montgomery —
+see ``device/fp.py``); the NATIVE C backend (``_native/bls12381.c``)
+uses Montgomery 6x64 CIOS. Both are tested for bit-equality against
+this module.
 """
 
 from __future__ import annotations

@@ -9,11 +9,15 @@ import os
 import sys
 import time
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# FORCE the CPU platform — the image presets JAX_PLATFORMS=axon (the real
+# TPU tunnel); a dead relay makes any axon initialization hang forever.
+os.environ["JAX_PLATFORMS"] = "cpu"
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
+
+jax.config.update("jax_platforms", "cpu")
 import jax.numpy as jnp
 
 from lighthouse_tpu.crypto.device import bls as dbls
