@@ -1,0 +1,144 @@
+"""Epoch-processing throughput at mainnet scale (BASELINE config #5's
+state-transition half: the 1M-validator epoch boundary).
+
+Builds a synthetic mainnet-preset altair state with N validators
+(realistic mix: ~99% participating, 0.1% slashed, sparse exits/ejections)
+and times ``process_epoch`` via both tiers:
+
+* columnar — numpy state views (``state_transition/state/epoch.py``)
+* scalar   — the spec-loop oracle (``process_epoch_scalar``)
+
+Both run the FULL epoch transition including tree-hash-free passes;
+equality of the resulting state roots is asserted when both tiers run at
+the same N. Usage::
+
+    python benches/bench_epoch.py [--n 1000000] [--scalar-n 100000]
+
+Prints one JSON line with both timings and the speedup, extrapolating
+scalar linearly when scalar-n < n (per-validator pass costs dominate and
+scale linearly; the extrapolation basis is printed)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from lighthouse_tpu.types import MAINNET, mainnet_spec  # noqa: E402
+from lighthouse_tpu.types.chain_spec import FAR_FUTURE_EPOCH  # noqa: E402
+from lighthouse_tpu.types.containers import types_for  # noqa: E402
+
+
+def build_state(n: int, seed: int = 7):
+    t = types_for(MAINNET)
+    rng = random.Random(seed)
+    cur_epoch = 10
+    state = t.state["altair"]()
+    state.slot = (cur_epoch + 1) * MAINNET.SLOTS_PER_EPOCH - 1
+    state.block_roots = [bytes([i % 251 + 1]) * 32 for i in range(len(state.block_roots))]
+    state.genesis_validators_root = b"\x42" * 32
+
+    max_eff = MAINNET.MAX_EFFECTIVE_BALANCE
+    validators, balances, prev_part, cur_part = [], [], [], []
+    for i in range(n):
+        r = rng.random()
+        slashed = r < 0.001
+        exiting = 0.001 <= r < 0.002
+        low = 0.002 <= r < 0.003
+        eff = 16 * 10**9 if low else max_eff
+        validators.append(
+            t.Validator(
+                pubkey=i.to_bytes(48, "little"),
+                withdrawal_credentials=b"\x00" * 32,
+                effective_balance=eff,
+                slashed=slashed,
+                activation_eligibility_epoch=0,
+                activation_epoch=0,
+                exit_epoch=cur_epoch + 3 if exiting else FAR_FUTURE_EPOCH,
+                withdrawable_epoch=(
+                    cur_epoch + MAINNET.EPOCHS_PER_SLASHINGS_VECTOR // 2
+                    if slashed
+                    else (cur_epoch + 7 if exiting else FAR_FUTURE_EPOCH)
+                ),
+            )
+        )
+        balances.append(eff + rng.randrange(0, 10**9))
+        # ~99% fully participating (source|target|head = 0b111)
+        part = 7 if rng.random() < 0.99 else rng.randrange(8)
+        prev_part.append(part)
+        cur_part.append(7 if rng.random() < 0.99 else 0)
+    state.validators = validators
+    state.balances = balances
+    state.previous_epoch_participation = prev_part
+    state.current_epoch_participation = cur_part
+    state.inactivity_scores = [0] * n
+    state.slashings = [10**12] * len(state.slashings)
+
+    root9 = state.block_roots[9 * MAINNET.SLOTS_PER_EPOCH % len(state.block_roots)]
+    state.previous_justified_checkpoint = t.Checkpoint(epoch=8, root=b"\x08" * 32)
+    state.current_justified_checkpoint = t.Checkpoint(epoch=9, root=root9)
+    state.finalized_checkpoint = t.Checkpoint(epoch=8, root=b"\x08" * 32)
+    state.justification_bits = [True, True, True, False]
+    return state
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=1_000_000)
+    ap.add_argument(
+        "--scalar-n",
+        type=int,
+        default=None,
+        help="run the scalar oracle at this size (default: same as --n)",
+    )
+    args = ap.parse_args()
+    spec = mainnet_spec()
+
+    from lighthouse_tpu.ssz import hash_tree_root
+    from lighthouse_tpu.state_transition.epoch import process_epoch_scalar
+    from lighthouse_tpu.state_transition.state import process_epoch_columnar
+
+    t0 = time.perf_counter()
+    state = build_state(args.n)
+    build_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    process_epoch_columnar(MAINNET, spec, state)
+    columnar_s = time.perf_counter() - t0
+
+    scalar_n = args.scalar_n or args.n
+    scalar_state = build_state(scalar_n)
+    t0 = time.perf_counter()
+    process_epoch_scalar(MAINNET, spec, scalar_state)
+    scalar_s = time.perf_counter() - t0
+
+    roots_equal = None
+    if scalar_n == args.n:
+        roots_equal = hash_tree_root(scalar_state) == hash_tree_root(state)
+        assert roots_equal, "columnar and scalar epoch transitions diverged"
+    scalar_s_at_n = scalar_s * (args.n / scalar_n)
+
+    print(
+        json.dumps(
+            {
+                "metric": "epoch_processing_1m_validators",
+                "n_validators": args.n,
+                "columnar_s": round(columnar_s, 3),
+                "scalar_s": round(scalar_s, 3),
+                "scalar_n": scalar_n,
+                "scalar_s_at_n": round(scalar_s_at_n, 3),
+                "speedup": round(scalar_s_at_n / columnar_s, 1),
+                "build_s": round(build_s, 3),
+                "roots_equal": roots_equal,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -2,9 +2,11 @@
 ``process_epoch``; reference: ``consensus/state_processing/src/
 per_epoch_processing/`` base + altair modules).
 
-The per-validator passes are written over plain Python sequences for
-spec clarity; the columnar/batched variants (numpy / device) hang off the
-same functions via the state views in ``state/`` as they land.
+Two tiers share this module's orchestration: the scalar spec loops below
+(the readable oracle, and the big-int fallback) and the columnar numpy
+passes over the state views in ``state/`` (the default — vector ops over
+the whole registry, the layout a device tier consumes). ``process_epoch``
+dispatches; ``tests/test_epoch_columnar.py`` pins the two bit-identical.
 """
 
 from __future__ import annotations
@@ -55,6 +57,27 @@ def fork_of(state) -> str:
 
 
 def process_epoch(preset: Preset, spec: ChainSpec, state) -> None:
+    """Dispatch: columnar (numpy state views, ``state/epoch.py``) by
+    default, scalar spec loops on guard fallback or when
+    ``LIGHTHOUSE_TPU_EPOCH=scalar`` pins the oracle path."""
+    import os
+
+    mode = os.environ.get("LIGHTHOUSE_TPU_EPOCH", "auto")
+    if mode != "scalar":
+        from .state import Fallback, process_epoch_columnar
+
+        try:
+            process_epoch_columnar(preset, spec, state)
+            return
+        except Fallback:
+            if mode == "columnar":
+                raise
+            # guards fire before any mutation: scalar rerun is safe
+
+    process_epoch_scalar(preset, spec, state)
+
+
+def process_epoch_scalar(preset: Preset, spec: ChainSpec, state) -> None:
     fork = fork_of(state)
     if fork == "phase0":
         process_justification_and_finalization_phase0(preset, state)

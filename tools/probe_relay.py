@@ -1,0 +1,34 @@
+"""Cheap axon-relay liveness probe (no JAX import, sub-second).
+
+The TPU is reached through a stdio relay (`/root/.relay.py`) that listens
+on localhost ports 8082/8092/8102... When the relay is dead, nothing
+listens and `jax.devices()` hangs forever (the axon plugin retries the
+connect). So the fastest truthful liveness signal is: does anything
+accept on the relay ports?
+
+Exit 0 = at least one relay port accepts (worth launching the real
+bench probe); exit 1 = relay dead (skip all TPU work).
+"""
+
+from __future__ import annotations
+
+import socket
+import sys
+
+PORTS = [8082, 8092, 8102, 8112]
+
+
+def relay_alive(timeout: float = 0.5) -> bool:
+    for port in PORTS:
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=timeout):
+                return True
+        except OSError:
+            continue
+    return False
+
+
+if __name__ == "__main__":
+    alive = relay_alive()
+    print("alive" if alive else "dead")
+    sys.exit(0 if alive else 1)
