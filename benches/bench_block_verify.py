@@ -1,0 +1,153 @@
+"""BASELINE measurement configs #2 and #4 (host CPU baseline, cpu-native
+C backend — the blst-class seam the TPU program must beat).
+
+Config #2 — BlockSignatureVerifier over one block's SignatureSets
+(reference ``block_signature_verifier.rs:120-132``): two tiers,
+  (a) harness tier: a REAL minimal-preset block produced+signed by the
+      StateHarness, accumulated via BlockSignatureAccumulator.include_all
+      and verified as one batch — end-to-end through the real
+      state-transition set constructors;
+  (b) mainnet-shaped tier: 1 proposal + 1 randao + 128 aggregate
+      attestations x 128-pubkey committees (the reference's mainnet
+      ceiling, ``MAX_ATTESTATIONS=128``), constructed directly and
+      verified as one batch — the per-block crypto workload at mainnet
+      scale.
+
+Config #4 — sync-committee: 512-signer contributions over 32 slots,
+``fast_aggregate_verify`` per slot (reference
+``sync_committee_verification.rs:561``).
+
+Prints one JSON line per config. Aggregate signatures are produced with
+the summed secret key (same group element as aggregating per-signer
+signatures) to keep setup time bounded."""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from lighthouse_tpu.crypto import backend as crypto_backend  # noqa: E402
+from lighthouse_tpu.crypto import bls  # noqa: E402
+from lighthouse_tpu.crypto.params import R  # noqa: E402
+
+
+def bench_config2_harness(reps: int = 3) -> dict:
+    from lighthouse_tpu.state_transition import BlockSignatureAccumulator
+    from lighthouse_tpu.state_transition.block import (
+        state_pubkey_bytes_resolver,
+        state_pubkey_resolver,
+    )
+    from lighthouse_tpu.testing import StateHarness
+    from lighthouse_tpu.types import MINIMAL, minimal_spec
+
+    spec = minimal_spec(altair_fork_epoch=0)
+    h = StateHarness(MINIMAL, spec, validator_count=64, fork_name="altair")
+    # two epochs of real blocks so the block carries attestations +
+    # sync-aggregate signatures over live committees
+    h.extend_chain(MINIMAL.SLOTS_PER_EPOCH * 2, strategy="bulk")
+    slot = h.state.slot + 1
+    atts = h.attestations_for_slot(h.state, h.state.slot)[: MINIMAL.MAX_ATTESTATIONS]
+    sb = h.produce_block(slot, attestations=atts, full_sync=True)
+
+    from lighthouse_tpu.state_transition import per_slot_processing
+
+    pre = h.state.copy()
+    while pre.slot < slot:
+        per_slot_processing(MINIMAL, spec, pre)
+
+    # persistent decompressed-pubkey caches, as the chain's
+    # ValidatorPubkeyCache provides in production (validator_pubkey_cache.rs:20)
+    resolver = state_pubkey_resolver(pre)
+    bytes_resolver = state_pubkey_bytes_resolver(pre)
+
+    def run() -> int:
+        acc = BlockSignatureAccumulator(
+            MINIMAL, spec, pre, resolver, bytes_resolver
+        )
+        acc.include_all(sb)
+        assert acc.verify() is True
+        return len(acc.sets)
+
+    n_sets = run()  # warm
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        run()
+    dt = (time.perf_counter() - t0) / reps
+    return {
+        "metric": "block_signature_verify_harness",
+        "config": "BASELINE#2a",
+        "n_sets": n_sets,
+        "block_verify_ms": round(dt * 1e3, 2),
+        "sets_per_sec": round(n_sets / dt, 1),
+        "backend": "cpu-native",
+    }
+
+
+def bench_config2_mainnet_shape(reps: int = 3) -> dict:
+    committee = 128
+    n_atts = 128
+    sks = [bls.SecretKey(10_000 + i) for i in range(committee)]
+    pks = [sk.public_key() for sk in sks]
+    sk_agg = bls.SecretKey(sum(10_000 + i for i in range(committee)) % R)
+
+    sets = []
+    proposer = bls.SecretKey(5)
+    root = b"\x01" * 32
+    sets.append(bls.SignatureSet(proposer.sign(root), [proposer.public_key()], root))
+    randao_root = b"\x02" * 32
+    sets.append(
+        bls.SignatureSet(proposer.sign(randao_root), [proposer.public_key()], randao_root)
+    )
+    for i in range(n_atts):
+        msg = bytes([3 + (i % 8)]) * 32  # a few distinct attestation roots
+        sets.append(bls.SignatureSet(sk_agg.sign(msg), pks, msg))
+
+    assert bls.verify_signature_sets(sets) is True
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        bls.verify_signature_sets(sets)
+    dt = (time.perf_counter() - t0) / reps
+    return {
+        "metric": "block_signature_verify_mainnet_shape",
+        "config": "BASELINE#2b",
+        "n_sets": len(sets),
+        "n_pubkey_rows": 2 + n_atts * committee,
+        "block_verify_ms": round(dt * 1e3, 2),
+        "sets_per_sec": round(len(sets) / dt, 1),
+        "backend": "cpu-native",
+    }
+
+
+def bench_config4_sync_committee(n_signers: int = 512, n_slots: int = 32) -> dict:
+    sks = [bls.SecretKey(20_000 + i) for i in range(n_signers)]
+    pks = [sk.public_key() for sk in sks]
+    sk_agg = bls.SecretKey(sum(20_000 + i for i in range(n_signers)) % R)
+    msgs = [bytes([m + 1]) * 32 for m in range(n_slots)]
+    sigs = [sk_agg.sign(m) for m in msgs]
+
+    ver_sets = [bls.SignatureSet(s, pks, m) for m, s in zip(msgs, sigs)]
+    assert ver_sets[0].verify() is True
+    t0 = time.perf_counter()
+    for vs in ver_sets:
+        assert vs.verify()
+    dt = time.perf_counter() - t0
+    return {
+        "metric": "sync_committee_fast_aggregate_verify",
+        "config": "BASELINE#4",
+        "n_signers": n_signers,
+        "n_slots": n_slots,
+        "total_s": round(dt, 3),
+        "verifications_per_sec": round(n_slots / dt, 1),
+        "backend": "cpu-native",
+    }
+
+
+if __name__ == "__main__":
+    crypto_backend.set_backend("cpu-native")
+    print(json.dumps(bench_config2_harness()))
+    print(json.dumps(bench_config2_mainnet_shape()))
+    print(json.dumps(bench_config4_sync_committee()))

@@ -2,6 +2,20 @@
 ``BeaconChainHarness``, ``testing/node_test_rig``): deterministic interop
 validators driving real state transitions with real BLS signatures."""
 
+from .fork_choice_runner import ForkChoiceRunner
 from .harness import StateHarness
 
-__all__ = ["StateHarness"]
+
+def spec_for_fork(fork: str):
+    """Minimal-preset ChainSpec with fork-activation epochs set for
+    ``fork`` — the one mapping shared by the ef vector generator and the
+    ef handlers (a fork added in only one place breaks the selfcheck)."""
+    from ..types.chain_spec import minimal_spec
+
+    return minimal_spec(
+        altair_fork_epoch=0 if fork != "phase0" else None,
+        bellatrix_fork_epoch=0 if fork == "bellatrix" else None,
+    )
+
+
+__all__ = ["ForkChoiceRunner", "StateHarness", "spec_for_fork"]

@@ -1,0 +1,96 @@
+"""ef_tests: fork_choice handler — drives the step format (anchor +
+tick/block/attestation/attester_slashing/checks) through the shared
+:class:`ForkChoiceRunner` (reference:
+``testing/ef_tests/src/cases/fork_choice.rs:1-688``, which drives a full
+``BeaconChainHarness`` the same way).
+
+The runner replays blocks with ``signature_strategy="none"``, so both
+fake-signed (self-generated) and real-signed (official) vectors drive.
+Cases containing step kinds this runner does not implement (merge
+``pow_block`` / ``payload_status`` scenarios) are SKIPPED, not failed —
+see tests/ef/README.md."""
+
+import pytest
+
+from ef_loader import (
+    FORKS,
+    cases,
+    load_ssz_snappy,
+    load_yaml,
+    require_vectors,
+)
+
+from lighthouse_tpu.testing import ForkChoiceRunner, spec_for_fork
+from lighthouse_tpu.types.containers import types_for
+from lighthouse_tpu.types.preset import MINIMAL
+
+_KNOWN_STEPS = ("tick", "block", "attestation", "attester_slashing", "checks")
+
+
+class _UnsupportedStep(Exception):
+    pass
+
+
+def _run_case(fork: str, case_dir) -> None:
+    t = types_for(MINIMAL)
+    spec = spec_for_fork(fork)
+    anchor_state = t.state[fork].decode(
+        load_ssz_snappy(case_dir / "anchor_state.ssz_snappy")
+    )
+    anchor_block = t.block[fork].decode(
+        load_ssz_snappy(case_dir / "anchor_block.ssz_snappy")
+    )
+    runner = ForkChoiceRunner(MINIMAL, spec, fork, anchor_state, anchor_block)
+    steps = load_yaml(case_dir / "steps.yaml")
+    if any(not any(k in step for k in _KNOWN_STEPS) for step in steps):
+        raise _UnsupportedStep(str(steps))
+
+    def apply(step, method, value):
+        if step.get("valid", True):
+            method(value)
+        else:
+            with pytest.raises(Exception):
+                method(value)
+
+    for i, step in enumerate(steps):
+        if "tick" in step:
+            runner.on_tick(step["tick"])
+        elif "block" in step:
+            sb = t.signed_block[fork].decode(
+                load_ssz_snappy(case_dir / (step["block"] + ".ssz_snappy"))
+            )
+            apply(step, runner.on_block, sb)
+        elif "attestation" in step:
+            att = t.Attestation.decode(
+                load_ssz_snappy(case_dir / (step["attestation"] + ".ssz_snappy"))
+            )
+            apply(step, runner.on_attestation, att)
+        elif "attester_slashing" in step:
+            sl = t.AttesterSlashing.decode(
+                load_ssz_snappy(case_dir / (step["attester_slashing"] + ".ssz_snappy"))
+            )
+            apply(step, runner.on_attester_slashing, sl)
+        elif "checks" in step:
+            got = runner.checks()
+            for key, expected in step["checks"].items():
+                if key not in got:
+                    continue  # official checks may include e.g. "time"
+                assert got[key] == expected, (
+                    f"{case_dir.name}[{fork}] step {i}: {key}: "
+                    f"{got[key]} != {expected}"
+                )
+
+
+@pytest.mark.parametrize("config", ["minimal"])
+def test_fork_choice_steps(config):
+    require_vectors()
+    ran = skipped = 0
+    for fork in FORKS:
+        for case_dir in cases(config, fork, "fork_choice", "get_head"):
+            try:
+                _run_case(fork, case_dir)
+                ran += 1
+            except _UnsupportedStep:
+                skipped += 1
+    if ran == 0:
+        pytest.skip(f"no consumable fork_choice cases ({skipped} unsupported)")

@@ -273,10 +273,212 @@ def generate_fork_vectors(out_root: str) -> int:
     return 1
 
 
+def _spec_for_fork(fork: str):
+    from lighthouse_tpu.testing import spec_for_fork
+
+    return spec_for_fork(fork)
+
+
+def generate_fork_choice(out_root: str, fork: str) -> int:
+    """fork_choice/get_head vectors (official step format: anchor +
+    tick/block/attestation/attester_slashing/checks), expected values
+    recorded from the shared ForkChoiceRunner (the same runner the ef
+    test drives — see its docstring for the self-generation caveat).
+    Reference format: ``testing/ef_tests/src/cases/fork_choice.rs``."""
+    from lighthouse_tpu.state_transition.helpers import get_indexed_attestation
+    from lighthouse_tpu.testing import ForkChoiceRunner
+
+    spec = _spec_for_fork(fork)
+    h = StateHarness(MINIMAL, spec, validator_count=16, fork_name=fork, fake_sign=True)
+    t = h.t
+    state_t = t.state[fork]
+    anchor_state = copy.deepcopy(h.state)
+    anchor_block = t.block[fork](
+        slot=0,
+        proposer_index=0,
+        parent_root=b"\x00" * 32,
+        state_root=hash_tree_root(anchor_state),
+        body=t.block_body[fork](),
+    )
+    runner = ForkChoiceRunner(MINIMAL, spec, fork, anchor_state, anchor_block)
+    assert runner.anchor_root in runner.states
+
+    case = os.path.join(
+        out_root, "tests", "minimal", fork, "fork_choice", "get_head",
+        "pyspec_tests", "fork_and_votes",
+    )
+    steps: list = []
+    counters = {"block": 0, "attestation": 0, "attester_slashing": 0}
+
+    def tick(slot: int) -> None:
+        tm = int(anchor_state.genesis_time + slot * spec.seconds_per_slot)
+        runner.on_tick(tm)
+        steps.append({"tick": tm})
+
+    def put(kind: str, tpe, value, valid: bool = True) -> None:
+        name = f"{kind}_{counters[kind]}"
+        counters[kind] += 1
+        _write(os.path.join(case, name + ".ssz_snappy"), _ssz_snappy(tpe, value))
+        step = {kind: name}
+        if not valid:
+            step["valid"] = False
+        steps.append(step)
+        apply = {
+            "block": runner.on_block,
+            "attestation": runner.on_attestation,
+            "attester_slashing": runner.on_attester_slashing,
+        }[kind]
+        if valid:
+            apply(value)
+        else:
+            try:
+                apply(value)
+            except Exception:
+                pass
+            else:
+                raise AssertionError(f"{name} unexpectedly applied cleanly")
+
+    def checks() -> None:
+        steps.append({"checks": runner.checks()})
+
+    sb_t = t.signed_block[fork]
+    # 1.5 epochs of a live chain with in-block attestations
+    for slot in range(1, 13):
+        tick(slot)
+        atts = (
+            h.attestations_for_slot(h.state, h.state.slot)[: MINIMAL.MAX_ATTESTATIONS]
+            if slot >= 2
+            else []
+        )
+        sb = h.produce_block(slot, attestations=atts)
+        h.process_block(sb, strategy="none")
+        put("block", sb_t, sb)
+    checks()
+
+    # competing children of the same parent at slot 13
+    parent_state = copy.deepcopy(h.state)
+    tick(13)
+    block_a = h.produce_block(13)
+    h.process_block(block_a, strategy="none")
+    state_a = copy.deepcopy(h.state)
+    put("block", sb_t, block_a)
+    h.state = copy.deepcopy(parent_state)
+    atts_b = h.attestations_for_slot(h.state, h.state.slot)
+    block_b = h.produce_block(13, attestations=atts_b[:1])
+    h.process_block(block_b, strategy="none")
+    state_b = copy.deepcopy(h.state)
+    put("block", sb_t, block_b)
+    checks()
+
+    # standalone committee votes for branch B, delivered next slot
+    tick(14)
+    votes_b = h.attestations_for_slot(state_b, 13)
+    for a in votes_b:
+        put("attestation", t.Attestation, a)
+    checks()
+
+    # equivocation: committee 0 voted both branches at slot 13
+    votes_a = h.attestations_for_slot(state_a, 13)
+    slashing = t.AttesterSlashing(
+        attestation_1=get_indexed_attestation(MINIMAL, state_a, votes_a[0]),
+        attestation_2=get_indexed_attestation(MINIMAL, state_b, votes_b[0]),
+    )
+    put("attester_slashing", t.AttesterSlashing, slashing)
+    checks()
+
+    # invalid: block from the future (no tick to slot 20)
+    h.state = copy.deepcopy(state_b)
+    future = h.produce_block(20)
+    put("block", sb_t, future, valid=False)
+    # invalid: unknown parent
+    orphan = copy.deepcopy(future)
+    orphan.message.parent_root = b"\x77" * 32
+    put("block", sb_t, orphan, valid=False)
+    checks()
+
+    _write(os.path.join(case, "anchor_state.ssz_snappy"), _ssz_snappy(state_t, anchor_state))
+    _write(os.path.join(case, "anchor_block.ssz_snappy"), _ssz_snappy(t.block[fork], anchor_block))
+    _write_yaml(os.path.join(case, "steps.yaml"), steps)
+    _write_yaml(os.path.join(case, "meta.yaml"), {"bls_setting": 2})
+    return 1
+
+
+def generate_rewards(out_root: str, fork: str) -> int:
+    """rewards vectors: pre-state + the balance vector after ONLY the
+    rewards/penalties pass (phase0 additionally pins the raw
+    deltas from get_attestation_deltas). Layout note: the official suite
+    splits per-component Deltas; this repo pins the combined pass output
+    instead — see tests/ef/README.md."""
+    spec = _spec_for_fork(fork)
+    h = StateHarness(MINIMAL, spec, validator_count=16, fork_name=fork, fake_sign=True)
+    t = h.t
+    state_t = t.state[fork]
+    h.extend_chain(MINIMAL.SLOTS_PER_EPOCH * 2 - 2, strategy="none")
+    pre = copy.deepcopy(h.state)
+    post = copy.deepcopy(pre)
+    case = os.path.join(
+        out_root, "tests", "minimal", fork, "rewards", "basic",
+        "pyspec_tests", "live_chain",
+    )
+    extra = {}
+    if fork == "phase0":
+        rewards, penalties = st_epoch.get_attestation_deltas(MINIMAL, post)
+        extra = {
+            "rewards": [int(x) for x in rewards],
+            "penalties": [int(x) for x in penalties],
+        }
+        st_epoch.process_rewards_and_penalties_phase0(MINIMAL, h.spec, post)
+    else:
+        st_epoch.process_inactivity_updates(MINIMAL, h.spec, post)
+        st_epoch.process_rewards_and_penalties_altair(MINIMAL, h.spec, post)
+    _write(os.path.join(case, "pre.ssz_snappy"), _ssz_snappy(state_t, pre))
+    _write_yaml(
+        os.path.join(case, "balances.yaml"),
+        {"balances": [int(b) for b in post.balances], **extra},
+    )
+    return 1
+
+
+def generate_merkle_proofs(out_root: str, fork: str) -> int:
+    """single_merkle_proof vectors (official light-client layout:
+    object.ssz_snappy + proof.yaml {leaf, leaf_index, branch})."""
+    from lighthouse_tpu.ssz.proof import compute_merkle_proof
+
+    spec = _spec_for_fork(fork)
+    h = StateHarness(MINIMAL, spec, validator_count=16, fork_name=fork, fake_sign=True)
+    h.extend_chain(3, strategy="none")
+    t = h.t
+    state_t = t.state[fork]
+    n = 0
+    paths = [["finalized_checkpoint"], ["latest_block_header"]]
+    if fork != "phase0":
+        paths.append(["next_sync_committee"])
+    for path in paths:
+        leaf, branch, gindex = compute_merkle_proof(h.state, path)
+        case = os.path.join(
+            out_root, "tests", "minimal", fork, "merkle_proof",
+            "single_merkle_proof", "BeaconState", "_".join(path),
+        )
+        _write(os.path.join(case, "object.ssz_snappy"), _ssz_snappy(state_t, h.state))
+        _write_yaml(
+            os.path.join(case, "proof.yaml"),
+            {
+                "leaf": "0x" + leaf.hex(),
+                "leaf_index": int(gindex),
+                "branch": ["0x" + b.hex() for b in branch],
+            },
+        )
+        n += 1
+    return n
+
+
 if __name__ == "__main__":
     out = sys.argv[1] if len(sys.argv) > 1 else "tests/ef/vectors"
     total = 0
-    for fork in ("phase0", "altair"):
+    for fork in ("phase0", "altair", "bellatrix"):
         total += generate(out, fork)
+        total += generate_fork_choice(out, fork)
+        total += generate_rewards(out, fork)
+        total += generate_merkle_proofs(out, fork)
     total += generate_fork_vectors(out)
     print(f"wrote {total} cases under {out}")
