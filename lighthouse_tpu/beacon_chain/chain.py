@@ -167,6 +167,7 @@ class BeaconChain:
         self.shuffling_cache = ShufflingCache()
         self.root_computer = CachedRootComputer()
         self.op_pool = None  # attached by the client builder when present
+        self.slasher = None  # attached by the client builder when enabled
         self.validator_monitor = None  # attached when monitoring is on
 
         # (root, state) swapped as ONE tuple so unlocked readers (HTTP

@@ -35,6 +35,7 @@ class ClientConfig:
     slots_per_snapshot: int = 32
     # None = off; "auto" = monitor every validator; or a list of indices
     monitor_validators: object = None
+    slasher: bool = False  # store-backed min-max-span slashing detection
 
 
 class Client:
@@ -60,10 +61,43 @@ class Client:
             if self.api is not None:
                 self.api.stop()
             self.processor.shutdown()
+            self.persist()
         finally:
             lock = getattr(self, "_lock", None)
             if lock is not None:
                 lock.release()
+
+    def persist(self):
+        """Write fork choice + op pool + slasher state to the store
+        (reference shutdown persistence: ``beacon_chain.rs:400-440``,
+        ``operation_pool/src/persistence.rs``)."""
+        from .fork_choice.persistence import fork_choice_to_bytes
+        from .operation_pool.persistence import pool_to_bytes
+        from .store.kv import Column
+
+        # independent try/excepts: one failed write must not discard the
+        # others, and persistence must never block shutdown
+        store = self.chain.store
+        try:
+            store.put_blob(
+                Column.FORK_CHOICE,
+                b"fork_choice",
+                fork_choice_to_bytes(self.chain.fork_choice),
+            )
+        except Exception:
+            pass
+        try:
+            if self.chain.op_pool is not None:
+                store.put_blob(
+                    Column.OP_POOL, b"pool", pool_to_bytes(self.chain.op_pool)
+                )
+        except Exception:
+            pass
+        try:
+            if self.chain.slasher is not None:
+                self.chain.slasher.flush()
+        except Exception:
+            pass
 
 
 class ClientBuilder:
@@ -173,7 +207,45 @@ class ClientBuilder:
         chain = BeaconChain(
             self.preset, self.spec, self.types, store, genesis, slot_clock=clock
         )
-        chain.op_pool = OperationPool(self.preset, self.spec, self.types)
+
+        # restore persisted fork choice + op pool (reference resume:
+        # beacon_chain.rs:400-440, operation_pool/src/persistence.rs)
+        from .store.kv import Column
+
+        fc_blob = store.get_blob(Column.FORK_CHOICE, b"fork_choice")
+        if fc_blob is not None:
+            from .fork_choice.persistence import fork_choice_from_bytes
+
+            try:
+                chain.fork_choice = fork_choice_from_bytes(
+                    self.preset, self.spec, fc_blob
+                )
+            except Exception:
+                pass  # corrupt/old blob: fall back to the anchor-built one
+
+        pool_blob = store.get_blob(Column.OP_POOL, b"pool")
+        if pool_blob is not None:
+            from .operation_pool.persistence import pool_from_bytes
+
+            try:
+                chain.op_pool = pool_from_bytes(
+                    self.preset, self.spec, self.types, pool_blob
+                )
+            except Exception:
+                chain.op_pool = OperationPool(self.preset, self.spec, self.types)
+        else:
+            chain.op_pool = OperationPool(self.preset, self.spec, self.types)
+
+        if cfg.slasher:
+            from .slasher import Slasher
+
+            # found slashings are drained into the op pool by the slot
+            # timer (reference: slasher/service/src/service.rs)
+            chain.slasher = Slasher(
+                self.types,
+                slots_per_epoch=self.preset.SLOTS_PER_EPOCH,
+                store=kv,
+            )
         if cfg.monitor_validators is not None:
             from .beacon_chain import ValidatorMonitor
 
@@ -217,6 +289,8 @@ def _build_processor(chain, n_workers: int) -> BeaconProcessor:
                 chain.apply_attestation_to_fork_choice(r)
                 if chain.op_pool is not None:
                     chain.op_pool.insert_attestation(r.attestation)
+                if chain.slasher is not None:
+                    chain.slasher.accept_attestation(r.indexed)
         return results
 
     def on_aggregate_batch(items):
@@ -226,9 +300,31 @@ def _build_processor(chain, n_workers: int) -> BeaconProcessor:
                 chain.apply_attestation_to_fork_choice(r)
                 if chain.op_pool is not None:
                     chain.op_pool.insert_attestation(r.signed_aggregate.message.aggregate)
+                if chain.slasher is not None:
+                    chain.slasher.accept_attestation(r.indexed)
         return results
 
     def on_block(item):
+        # the slasher must see the header BEFORE gossip verification: an
+        # equivocating second block is rejected there as RepeatProposal,
+        # which is exactly the event that yields a ProposerSlashing
+        if chain.slasher is not None:
+            msg = item.message
+            from .ssz import hash_tree_root as _htr
+
+            header = chain.types.SignedBeaconBlockHeader(
+                message=chain.types.BeaconBlockHeader(
+                    slot=msg.slot,
+                    proposer_index=msg.proposer_index,
+                    parent_root=msg.parent_root,
+                    state_root=msg.state_root,
+                    body_root=_htr(msg.body),
+                ),
+                signature=item.signature,
+            )
+            found = chain.slasher.check_block_header(header)
+            if found is not None and chain.op_pool is not None:
+                chain.op_pool.insert_proposer_slashing(found)
         gossip = chain.verify_block_for_gossip(item)
         return chain.process_block(gossip)
 
@@ -280,6 +376,7 @@ def _slot_timer(chain, clock, stop: threading.Event) -> None:
     """Per-slot tick (reference ``timer/src/lib.rs``): advance fork
     choice's clock and re-evaluate the head each slot, until stopped."""
     last = -1
+    last_pruned_epoch = [0]
     while not stop.is_set():
         slot = clock.now()
         if slot != last:
@@ -287,6 +384,27 @@ def _slot_timer(chain, clock, stop: threading.Event) -> None:
                 chain.on_tick(slot)
             except Exception:
                 pass
+            if chain.slasher is not None:
+                # periodic batch processing + evidence → op pool, and
+                # pruning on finalization advance (reference:
+                # slasher/service/src/service.rs)
+                try:
+                    chain.slasher.process_queued()
+                    if chain.op_pool is not None:
+                        while chain.slasher.found_attester_slashings:
+                            chain.op_pool.insert_attester_slashing(
+                                chain.slasher.found_attester_slashings.pop(0)
+                            )
+                        while chain.slasher.found_proposer_slashings:
+                            chain.op_pool.insert_proposer_slashing(
+                                chain.slasher.found_proposer_slashings.pop(0)
+                            )
+                    fin = chain.fork_choice.store.finalized_checkpoint[0]
+                    if fin > last_pruned_epoch[0]:
+                        chain.slasher.prune(fin)
+                        last_pruned_epoch[0] = fin
+                except Exception:
+                    pass
             last = slot
         stop.wait(min(1.0, max(0.05, clock.duration_to_next_slot())))
 

@@ -31,6 +31,14 @@ from ..ssz import hash_tree_root
 _NO_SPAN = np.iinfo(np.int64).max
 
 
+def _b64(v: int) -> bytes:
+    return int(v).to_bytes(8, "big")
+
+
+def _u64(b: bytes) -> int:
+    return int.from_bytes(b, "big")
+
+
 class AttesterSlashingStatus(enum.Enum):
     NOT_SLASHABLE = "not_slashable"
     DOUBLE_VOTE = "double_vote"
@@ -49,11 +57,21 @@ class Slasher:
         history_length: int = 4096,
         on_slashing: Optional[Callable] = None,
         slots_per_epoch: int = 32,
+        store=None,
     ):
+        """``store``: optional :class:`KeyValueStore`; when given, span
+        arrays + evidence persist under ``Column.SLASHER`` and reload on
+        construction (reference: the LMDB database behind
+        ``slasher/src/database/lmdb_impl.rs:1-203``). Writes are batched
+        per ``process_queued``/``check_block_header`` call."""
         self.t = types
         self.history = history_length
         self.slots_per_epoch = slots_per_epoch
         self.on_slashing = on_slashing
+        self._store = store
+        self._dirty_spans: set[int] = set()
+        self._dirty_targets: set[tuple[int, int]] = set()
+        self._dirty_blocks: set[tuple[int, int]] = set()
         self._lock = threading.Lock()
         # spans index epochs relative to this sliding base; advancing the
         # base shifts every validator's arrays (reference: the chunked
@@ -72,6 +90,19 @@ class Slasher:
         self._queue: list = []
         self.found_attester_slashings: list = []
         self.found_proposer_slashings: list = []
+        if store is not None:
+            try:
+                self._load()
+            except Exception:
+                # corrupt/mismatched persisted state must not brick
+                # startup (same degrade-to-fresh contract as the client's
+                # fork-choice and op-pool restores)
+                self._base = 0
+                self._min_span.clear()
+                self._max_span.clear()
+                self._by_target.clear()
+                self._by_source.clear()
+                self._blocks.clear()
 
     # -- ingestion (queued, like the reference's batching queues) --------
 
@@ -87,6 +118,7 @@ class Slasher:
         found = 0
         for att in batch:
             found += len(self.check_attestation(att))
+        self.flush()
         return found
 
     # -- attestations ----------------------------------------------------
@@ -177,7 +209,9 @@ class Slasher:
         entries = self._by_target.setdefault((v, t), [])
         if all(r != root for r, _ in entries):
             entries.append((root, indexed))
+            self._dirty_targets.add((v, t))
         self._by_source.setdefault((v, s), []).append(t)
+        self._dirty_spans.add(v)
         self._maybe_rebase(t)
         mn, mx = self._spans(v)
         base = self._base
@@ -209,6 +243,7 @@ class Slasher:
             mx[:-shift] = mx[shift:] if shift < self.history else -1
             mx[-shift:] = -1
         self._base = new_base
+        self._dirty_spans.update(self._min_span)  # the shift touched all
 
     # -- blocks ----------------------------------------------------------
 
@@ -217,20 +252,21 @@ class Slasher:
         msg = signed_header.message
         key = (msg.proposer_index, msg.slot)
         root = hash_tree_root(msg)
+        slashing = None
         with self._lock:
             prev = self._blocks.get(key)
             if prev is None:
                 self._blocks[key] = (root, signed_header)
-                return None
-            if prev[0] == root:
-                return None
-            slashing = self.t.ProposerSlashing(
-                signed_header_1=prev[1], signed_header_2=signed_header
-            )
-            self.found_proposer_slashings.append(slashing)
-            if self.on_slashing:
-                self.on_slashing("double_proposal", signed_header, prev[1])
-            return slashing
+                self._dirty_blocks.add(key)
+            elif prev[0] != root:
+                slashing = self.t.ProposerSlashing(
+                    signed_header_1=prev[1], signed_header_2=signed_header
+                )
+                self.found_proposer_slashings.append(slashing)
+        if slashing is not None and self.on_slashing:
+            self.on_slashing("double_proposal", signed_header, prev[1])
+        self.flush()
+        return slashing
 
     # -- maintenance -----------------------------------------------------
 
@@ -247,3 +283,135 @@ class Slasher:
                 for k, v in self._blocks.items()
                 if k[1] >= finalized_epoch * self.slots_per_epoch
             }
+            # dirty entries for pruned keys must not resurrect store rows
+            self._dirty_targets = {
+                k for k in self._dirty_targets if k in self._by_target
+            }
+            self._dirty_blocks = {k for k in self._dirty_blocks if k in self._blocks}
+            if self._store is not None:
+                from ..store.kv import Column
+
+                drop = []
+                for key in list(self._store.keys(Column.SLASHER)):
+                    if key[:1] == b"a" and _u64(key[9:17]) < finalized_epoch:
+                        drop.append(key)
+                    elif key[:1] == b"b" and (
+                        _u64(key[9:17]) < finalized_epoch * self.slots_per_epoch
+                    ):
+                        drop.append(key)
+                for key in drop:
+                    self._store.delete(Column.SLASHER, key)
+
+    # -- persistence (reference: slasher/src/database/lmdb_impl.rs) ------
+
+    def flush(self) -> None:
+        """Write dirty spans/evidence/blocks to the store in one batch."""
+        if self._store is None:
+            return
+        import json
+
+        from ..store.kv import Column
+
+        with self._lock:
+            if not (self._dirty_spans or self._dirty_targets or self._dirty_blocks):
+                return
+            items = [
+                (
+                    Column.SLASHER,
+                    b"meta",
+                    json.dumps(
+                        {
+                            "version": 1,
+                            "base": self._base,
+                            "history": self.history,
+                            "slots_per_epoch": self.slots_per_epoch,
+                        }
+                    ).encode(),
+                )
+            ]
+            for v in self._dirty_spans:
+                mn, mx = self._spans(v)
+                items.append(
+                    (Column.SLASHER, b"s" + _b64(v), mn.tobytes() + mx.tobytes())
+                )
+            for v, t in self._dirty_targets:
+                entries = self._by_target.get((v, t), [])
+                items.append(
+                    (
+                        Column.SLASHER,
+                        b"a" + _b64(v) + _b64(t),
+                        json.dumps(
+                            [
+                                [
+                                    r.hex(),
+                                    self.t.IndexedAttestation.encode(att).hex(),
+                                ]
+                                for r, att in entries
+                            ]
+                        ).encode(),
+                    )
+                )
+            for p, slot in self._dirty_blocks:
+                entry = self._blocks.get((p, slot))
+                if entry is None:  # pruned between marking and flush
+                    continue
+                root, header = entry
+                items.append(
+                    (
+                        Column.SLASHER,
+                        b"b" + _b64(p) + _b64(slot),
+                        json.dumps(
+                            [root.hex(), self.t.SignedBeaconBlockHeader.encode(header).hex()]
+                        ).encode(),
+                    )
+                )
+            self._store.put_batch(items)
+            self._dirty_spans.clear()
+            self._dirty_targets.clear()
+            self._dirty_blocks.clear()
+
+    def _load(self) -> None:
+        """Restore spans + evidence from the store (init-time; lock not
+        yet shared). ``_by_source`` is derived from the evidence."""
+        import json
+
+        from ..store.kv import Column
+
+        meta = self._store.get(Column.SLASHER, b"meta")
+        if meta is None:
+            return
+        doc = json.loads(meta.decode())
+        if doc.get("history") != self.history:
+            raise ValueError(
+                f"slasher history mismatch: store {doc.get('history')}, "
+                f"configured {self.history}"
+            )
+        self._base = int(doc["base"])
+        for key, value in self._store.iter_column(Column.SLASHER):
+            tag = key[:1]
+            if tag == b"s":
+                v = _u64(key[1:9])
+                arr = np.frombuffer(value, np.int64).copy()
+                self._min_span[v] = arr[: self.history]
+                self._max_span[v] = arr[self.history :]
+            elif tag == b"a":
+                v, t = _u64(key[1:9]), _u64(key[9:17])
+                entries = [
+                    (
+                        bytes.fromhex(r),
+                        self.t.IndexedAttestation.decode(bytes.fromhex(att)),
+                    )
+                    for r, att in json.loads(value.decode())
+                ]
+                self._by_target[(v, t)] = entries
+                for _, att in entries:
+                    self._by_source.setdefault(
+                        (v, int(att.data.source.epoch)), []
+                    ).append(t)
+            elif tag == b"b":
+                p, slot = _u64(key[1:9]), _u64(key[9:17])
+                r, header = json.loads(value.decode())
+                self._blocks[(p, slot)] = (
+                    bytes.fromhex(r),
+                    self.t.SignedBeaconBlockHeader.decode(bytes.fromhex(header)),
+                )

@@ -29,6 +29,7 @@ class Column:
     METADATA = "meta"
     FORK_CHOICE = "frk"
     OP_POOL = "opo"
+    SLASHER = "sls"
 
 
 class KeyValueStore:

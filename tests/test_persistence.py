@@ -1,0 +1,129 @@
+"""Kill-and-restart persistence: fork choice, op pool, and slasher state
+must survive a client restart via the store (VERDICT r3 missing #4;
+reference: ``beacon_chain.rs:400-440`` persisted fork choice,
+``operation_pool/src/persistence.rs``, slasher LMDB
+``slasher/src/database/lmdb_impl.rs``)."""
+
+import copy
+
+import pytest
+
+from lighthouse_tpu.client import ClientBuilder, ClientConfig
+from lighthouse_tpu.crypto import backend
+from lighthouse_tpu.ssz import hash_tree_root
+from lighthouse_tpu.testing import StateHarness
+from lighthouse_tpu.types import MINIMAL, minimal_spec
+
+
+@pytest.fixture(autouse=True)
+def fake_backend():
+    backend.set_backend("fake")
+    yield
+    backend.set_backend("cpu")
+
+
+def _build(datadir, genesis=None):
+    cfg = ClientConfig(
+        preset_base="minimal",
+        datadir=str(datadir),
+        http_enabled=False,
+        bls_backend="fake",
+        slasher=True,
+    )
+    b = ClientBuilder(cfg, minimal_spec())
+    if genesis is not None:
+        b.genesis_state = genesis
+    return b.build()
+
+
+def _att_with(h, state, slot, source_epoch, target_epoch):
+    """Indexed attestation with chosen FFG span (slasher fodder)."""
+    t = h.t
+    data = t.AttestationData(
+        slot=slot,
+        index=0,
+        beacon_block_root=b"\x01" * 32,
+        source=t.Checkpoint(epoch=source_epoch, root=b"\x0a" * 32),
+        target=t.Checkpoint(epoch=target_epoch, root=b"\x0b" * 32),
+    )
+    return t.IndexedAttestation(
+        attesting_indices=[2, 3], data=data, signature=b"\x00" * 96
+    )
+
+
+def test_kill_and_restart_preserves_state(tmp_path):
+    h = StateHarness(
+        MINIMAL, minimal_spec(), validator_count=8, fork_name="phase0",
+        fake_sign=True,
+    )
+    genesis = copy.deepcopy(h.state)
+
+    client = _build(tmp_path, genesis=genesis)
+    chain = client.chain
+    try:
+        # grow a small chain straight through the chain API
+        roots = []
+        for _ in range(3):
+            slot = h.state.slot + 1
+            chain.slot_clock.set_slot(slot) if hasattr(
+                chain.slot_clock, "set_slot"
+            ) else None
+            sb = h.produce_block(slot)
+            h.process_block(sb, strategy="none")
+            gossip = chain.verify_block_for_gossip(sb)
+            roots.append(chain.process_block(gossip))
+        head_before = chain.fork_choice.get_head()
+        n_nodes_before = len(chain.fork_choice.proto.nodes)
+
+        # op pool content
+        ex = h.t.SignedVoluntaryExit(
+            message=h.t.VoluntaryExit(epoch=0, validator_index=5),
+            signature=b"\x00" * 96,
+        )
+        chain.op_pool.insert_voluntary_exit(ex)
+        att = h.attestations_for_slot(h.state, h.state.slot - 1)[0]
+        chain.op_pool.insert_attestation(att)
+
+        # slasher evidence: one attestation recorded pre-restart
+        chain.slasher.accept_attestation(_att_with(h, h.state, 8, 2, 5))
+        assert chain.slasher.process_queued() == 0
+    finally:
+        client.stop()
+
+    # ---- restart from the same datadir (no genesis supplied) -----------
+    client2 = _build(tmp_path)
+    chain2 = client2.chain
+    try:
+        assert chain2.fork_choice.get_head() == head_before
+        assert len(chain2.fork_choice.proto.nodes) == n_nodes_before
+        for r in roots:
+            assert chain2.fork_choice.proto.contains(r)
+
+        assert 5 in chain2.op_pool._voluntary_exits
+        assert chain2.op_pool.n_attestations() == 1
+
+        # the surround vote is only seen AFTER restart: detection must
+        # come from the PERSISTED spans/evidence
+        chain2.slasher.accept_attestation(_att_with(h, h.state, 8, 1, 6))
+        found = chain2.slasher.process_queued()
+        assert found > 0, "persisted spans failed to catch the surround vote"
+        sl = chain2.slasher.found_attester_slashings[0]
+        # surrounding attestation must be attestation_1 (spec evidence order)
+        assert sl.attestation_1.data.source.epoch == 1
+        assert sl.attestation_2.data.source.epoch == 2
+    finally:
+        client2.stop()
+
+
+def test_restart_without_prior_state_is_clean(tmp_path):
+    """A fresh datadir must behave exactly as before the change."""
+    h = StateHarness(
+        MINIMAL, minimal_spec(), validator_count=8, fork_name="phase0",
+        fake_sign=True,
+    )
+    client = _build(tmp_path, genesis=copy.deepcopy(h.state))
+    try:
+        assert client.chain.op_pool.n_attestations() == 0
+        assert len(client.chain.fork_choice.proto.nodes) == 1
+    finally:
+        client.stop()
