@@ -244,6 +244,79 @@ def bench_state_rehash(n_validators: int) -> dict:
     }
 
 
+def bench_attestation_production(n_validators: int = 2_000) -> dict:
+    """Attestation-production latency across an epoch boundary: the
+    production caches (early-attester template / attester cache /
+    pre-advanced state) vs the cold path (full state copy + epoch
+    advance) — the latency the reference buys with
+    ``early_attester_cache.rs`` + ``state_advance_timer.rs``."""
+    from lighthouse_tpu.beacon_chain import BeaconChain
+    from lighthouse_tpu.crypto import backend
+    from lighthouse_tpu.state_transition import store_replayer
+    from lighthouse_tpu.store import HotColdDB, MemoryStore
+    from lighthouse_tpu.testing import StateHarness
+    from lighthouse_tpu.types import MINIMAL, minimal_spec
+    from lighthouse_tpu.utils.slot_clock import ManualSlotClock
+
+    backend.set_backend("fake")
+    try:
+        h = StateHarness(
+            MINIMAL, minimal_spec(), validator_count=n_validators,
+            fork_name="phase0", fake_sign=True,
+        )
+        genesis = copy.deepcopy(h.state)
+        db = HotColdDB(
+            MemoryStore(), h.t, h.spec, store_replayer(h.preset, h.spec),
+            slots_per_snapshot=8,
+        )
+        clock = ManualSlotClock(genesis.genesis_time, h.spec.seconds_per_slot)
+        chain = BeaconChain(h.preset, h.spec, h.t, db, genesis, slot_clock=clock)
+        for _ in range(2):
+            slot = h.state.slot + 1
+            clock.set_slot(slot)
+            sb = h.produce_block(slot)
+            h.process_block(sb, strategy="none")
+            chain.process_block(chain.verify_block_for_gossip(sb))
+
+        boundary_slot = MINIMAL.SLOTS_PER_EPOCH + 1
+        clock.set_slot(boundary_slot)
+
+        def timed(f):
+            t0 = time.perf_counter()
+            out = f()
+            return out, time.perf_counter() - t0
+
+        # cold: no caches — full copy + epoch advance
+        chain.early_attester_cache._item = None
+        chain.attester_cache._map.clear()
+        chain._advanced = None
+        a_cold, t_cold = timed(
+            lambda: chain.produce_unaggregated_attestation(boundary_slot, 0)
+        )
+        # warm: attester cache filled by the cold call
+        a_warm, t_warm = timed(
+            lambda: chain.produce_unaggregated_attestation(boundary_slot, 0)
+        )
+        assert a_cold == a_warm
+        # pre-advanced (state-advance timer ran, caches cleared)
+        chain.attester_cache._map.clear()
+        chain.advance_head_state_to(boundary_slot)
+        a_adv, t_adv = timed(
+            lambda: chain.produce_unaggregated_attestation(boundary_slot, 0)
+        )
+        assert a_adv == a_cold
+        return {
+            "n_validators": n_validators,
+            "cold_ms": round(t_cold * 1e3, 2),
+            "attester_cache_ms": round(t_warm * 1e3, 3),
+            "pre_advanced_ms": round(t_adv * 1e3, 3),
+            "speedup_cache": round(t_cold / max(t_warm, 1e-9), 1),
+            "speedup_pre_advanced": round(t_cold / max(t_adv, 1e-9), 1),
+        }
+    finally:
+        backend.set_backend("cpu")
+
+
 if __name__ == "__main__":
     n_atts = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
     n_vals = int(sys.argv[2]) if len(sys.argv) > 2 else 100_000
@@ -251,5 +324,6 @@ if __name__ == "__main__":
         "gossip_pipeline_e2e": bench_gossip_pipeline(n_atts, real=True),
         "gossip_pipeline_host_only": bench_gossip_pipeline(n_atts),
         "state_rehash": bench_state_rehash(n_vals),
+        "attestation_production": bench_attestation_production(),
     }
     print(json.dumps(out, indent=2))

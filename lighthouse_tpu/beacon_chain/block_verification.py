@@ -60,7 +60,10 @@ class GossipVerifiedBlock:
         if not chain.fork_choice.proto.contains(parent_root):
             raise BlockError("ParentUnknown", parent_root.hex()[:12])
 
-        state = chain.state_at_block_root(parent_root)
+        state = (
+            chain.advanced_state_for(parent_root, block.slot)
+            or chain.state_at_block_root(parent_root)
+        )
         state = partial_state_advance(chain.preset, chain.spec, copy.deepcopy(state), block.slot)
         expected = get_beacon_proposer_index(chain.preset, state)
         if expected != block.proposer_index:
@@ -103,7 +106,10 @@ class SignatureVerifiedBlock:
         parent_root = bytes(block.parent_root)
         if not chain.fork_choice.proto.contains(parent_root):
             raise BlockError("ParentUnknown", parent_root.hex()[:12])
-        state = chain.state_at_block_root(parent_root)
+        state = (
+            chain.advanced_state_for(parent_root, block.slot)
+            or chain.state_at_block_root(parent_root)
+        )
         state = partial_state_advance(
             chain.preset, chain.spec, copy.deepcopy(state), block.slot
         )

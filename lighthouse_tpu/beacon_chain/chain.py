@@ -56,6 +56,10 @@ _BLOCK_PROCESSING = metrics.histogram(
     "block_processing_seconds", "full block import wall time"
 )
 _HEAD_RECOMPUTE = metrics.counter("head_recompute_total", "get_head invocations")
+_BLOCK_OBSERVED_TO_HEAD = metrics.histogram(
+    "beacon_block_observed_to_head_seconds",
+    "gossip-observed to set-as-head delay (block_times_cache)",
+)
 
 
 class SnapshotCache:
@@ -166,6 +170,20 @@ class BeaconChain:
         self.snapshot_cache = SnapshotCache()
         self.shuffling_cache = ShufflingCache()
         self.root_computer = CachedRootComputer()
+        from .caches import (
+            AttesterCache,
+            BeaconProposerCache,
+            BlockTimesCache,
+            EarlyAttesterCache,
+        )
+
+        self.early_attester_cache = EarlyAttesterCache()
+        self.beacon_proposer_cache = BeaconProposerCache()
+        self.attester_cache = AttesterCache()
+        self.block_times_cache = BlockTimesCache()
+        # state pre-advanced to the next slot by the state-advance timer:
+        # (head_block_root, state) — see advance_head_state_to()
+        self._advanced: tuple[bytes, object] | None = None
         self.op_pool = None  # attached by the client builder when present
         self.slasher = None  # attached by the client builder when enabled
         self.validator_monitor = None  # attached when monitoring is on
@@ -268,7 +286,9 @@ class BeaconChain:
         # state; gossip blocks arrive ~1/slot, so holding the lock across
         # the single proposal-signature check costs nothing.
         with self._chain_lock:
-            return GossipVerifiedBlock.new(self, signed_block)
+            gossip = GossipVerifiedBlock.new(self, signed_block)
+        self.block_times_cache.set_observed(gossip.block_root)
+        return gossip
 
     def process_block(self, block, execution_status=ExecutionStatus.IRRELEVANT):
         """Import a block through the full pipeline. Accepts a raw
@@ -322,6 +342,28 @@ class BeaconChain:
         self.store.put_block(sv.block_root, signed_block)
         self.store.put_state(post_root, state)
         self.snapshot_cache.insert(sv.block_root, state)
+
+        # early-attester template: attesting to THIS block this epoch
+        # needs no state access (reference beacon_chain.rs:1496-1512)
+        epoch = compute_epoch_at_slot(self.preset, block.slot)
+        epoch_start = epoch * self.preset.SLOTS_PER_EPOCH
+        target_root = (
+            sv.block_root
+            if block.slot == epoch_start
+            else bytes(
+                state.block_roots[epoch_start % self.preset.SLOTS_PER_HISTORICAL_ROOT]
+            )
+        )
+        self.early_attester_cache.add(
+            epoch,
+            sv.block_root,
+            (
+                state.current_justified_checkpoint.epoch,
+                bytes(state.current_justified_checkpoint.root),
+            ),
+            target_root,
+        )
+        self.block_times_cache.set_imported(sv.block_root)
 
         self.recompute_head()
         return sv.block_root
@@ -438,6 +480,15 @@ class BeaconChain:
                 state = self.store.get_state(bytes(head_block.message.state_root))
             self._head = (head_root, state)  # atomic pair swap
             self.store.put_head(head_root)
+            self.block_times_cache.set_became_head(head_root)
+            # the pre-advanced state belongs to the previous head; entries
+            # are keyed by root so a stale one is merely unused, but drop
+            # it so the timer re-advances for the new head promptly
+            if self._advanced is not None and self._advanced[0] != head_root:
+                self._advanced = None
+            delays = self.block_times_cache.delays(head_root)
+            if "observed_to_head" in delays:
+                _BLOCK_OBSERVED_TO_HEAD.observe(delays["observed_to_head"])
         # Finalization is advanced by fork_choice.on_block, so compare
         # against the chain's own last-seen epoch, not a before/after of
         # the fork-choice store within this call.
@@ -515,21 +566,51 @@ class BeaconChain:
 
     def produce_unaggregated_attestation(self, slot: int, committee_index: int):
         """AttestationData for a duty (reference
-        ``produce_unaggregated_attestation`` ``beacon_chain.rs:1496``)."""
+        ``produce_unaggregated_attestation`` ``beacon_chain.rs:1496``).
+
+        Fast paths, in order: the early-attester template (filled at
+        block import — zero state access), then the attester cache
+        (cross-epoch FFG info), then the state-advance-timer's
+        pre-advanced state, then a fresh copy+advance (which refills the
+        attester cache)."""
         t = self.types
-        state = self.head_state
-        # An epoch boundary between the head and the duty slot changes the
-        # justified checkpoint — advance a copy so the FFG source matches
-        # what every other node's advanced state expects (the reference
-        # pre-advances via state_advance_timer).
-        if (
-            compute_epoch_at_slot(self.preset, state.slot)
-            < compute_epoch_at_slot(self.preset, slot)
-        ):
-            state = partial_state_advance(
-                self.preset, self.spec, copy.deepcopy(state), slot
-            )
         epoch = compute_epoch_at_slot(self.preset, slot)
+        head_root, head_state = self.head_info()  # consistent pair
+
+        item = self.early_attester_cache.try_attest(epoch, head_root)
+        if item is not None:
+            return t.AttestationData(
+                slot=slot,
+                index=committee_index,
+                beacon_block_root=item.beacon_block_root,
+                source=t.Checkpoint(epoch=item.source[0], root=item.source[1]),
+                target=t.Checkpoint(epoch=epoch, root=item.target_root),
+            )
+
+        state = head_state
+        if compute_epoch_at_slot(self.preset, state.slot) < epoch:
+            # epoch boundary between head and duty slot: the justified
+            # checkpoint changes at the boundary
+            info = self.attester_cache.get(epoch, head_root)
+            if info is not None:
+                return t.AttestationData(
+                    slot=slot,
+                    index=committee_index,
+                    beacon_block_root=head_root,
+                    source=t.Checkpoint(epoch=info.source[0], root=info.source[1]),
+                    target=t.Checkpoint(epoch=epoch, root=info.target_root),
+                )
+            advanced = self._advanced
+            if (
+                advanced is not None
+                and advanced[0] == head_root
+                and compute_epoch_at_slot(self.preset, advanced[1].slot) >= epoch
+            ):
+                state = advanced[1]  # read-only use
+            else:
+                state = partial_state_advance(
+                    self.preset, self.spec, copy.deepcopy(state), slot
+                )
         target_slot = epoch * self.preset.SLOTS_PER_EPOCH
         if state.slot > target_slot:
             hist = state.block_roots[
@@ -537,14 +618,91 @@ class BeaconChain:
             ]
             target_root = bytes(hist)
         else:
-            target_root = self.head_block_root
+            target_root = head_root
+        from .caches import AttesterDutyInfo
+
+        self.attester_cache.insert(
+            epoch,
+            head_root,
+            AttesterDutyInfo(
+                source=(
+                    state.current_justified_checkpoint.epoch,
+                    bytes(state.current_justified_checkpoint.root),
+                ),
+                target_root=target_root,
+            ),
+        )
         return t.AttestationData(
             slot=slot,
             index=committee_index,
-            beacon_block_root=self.head_block_root,
+            beacon_block_root=head_root,
             source=state.current_justified_checkpoint,
             target=t.Checkpoint(epoch=epoch, root=target_root),
         )
+
+    def advance_head_state_to(self, slot: int) -> bool:
+        """State-advance timer body (reference
+        ``state_advance_timer.rs:93-231``): near the end of a slot,
+        pre-advance a COPY of the head state to the next slot so block
+        verification and attestation production at the slot boundary skip
+        the per-slot (and at boundaries, per-epoch) processing spike.
+        Returns True when an advance was performed. The copy + advance run
+        OUTSIDE the chain lock (the whole point is not to stall gossip and
+        import during the boundary spike); the result is published only if
+        the head did not move meanwhile."""
+        head_root, head_state = self.head_info()
+        advanced = self._advanced
+        if advanced is not None and advanced[0] == head_root and (
+            advanced[1].slot >= slot
+        ):
+            return False
+        if head_state.slot >= slot:
+            return False
+        state = partial_state_advance(
+            self.preset, self.spec, copy.deepcopy(head_state), slot
+        )
+        with self._chain_lock:
+            if self._head[0] != head_root:
+                return False  # advanced a stale head: discard
+            self._advanced = (head_root, state)
+        return True
+
+    def advanced_state_for(self, parent_root: bytes, slot: int):
+        """The pre-advanced state when it matches (root, <=slot); None
+        otherwise. Callers must deepcopy before mutating."""
+        advanced = self._advanced
+        if (
+            advanced is not None
+            and advanced[0] == parent_root
+            and advanced[1].slot <= slot
+        ):
+            return advanced[1]
+        return None
+
+    def proposers_for_epoch(self, epoch: int) -> list[int]:
+        """Proposer index for every slot of ``epoch``, cached on
+        (epoch, head root) (reference ``beacon_proposer_cache.rs``)."""
+        head_root, head_state = self.head_info()  # consistent pair
+        cached = self.beacon_proposer_cache.get(epoch, head_root)
+        if cached is not None:
+            return cached
+        from ..state_transition.helpers import proposer_index_at_slot
+
+        P = self.preset
+        start = epoch * P.SLOTS_PER_EPOCH
+        state = head_state
+        if state.slot < start:
+            state = self.advanced_state_for(head_root, start)
+            if state is None or compute_epoch_at_slot(P, state.slot) < epoch:
+                state = partial_state_advance(
+                    P, self.spec, copy.deepcopy(head_state), start
+                )
+        proposers = [
+            proposer_index_at_slot(P, state, s)
+            for s in range(start, start + P.SLOTS_PER_EPOCH)
+        ]
+        self.beacon_proposer_cache.insert(epoch, head_root, proposers)
+        return proposers
 
 
 def _anchor_block_root(state) -> bytes:

@@ -406,7 +406,17 @@ def _slot_timer(chain, clock, stop: threading.Event) -> None:
                 except Exception:
                     pass
             last = slot
-        stop.wait(min(1.0, max(0.05, clock.duration_to_next_slot())))
+        # state-advance timer (reference state_advance_timer.rs:93-231):
+        # in the last quarter of the slot, pre-advance the head state to
+        # the next slot so the boundary spike is paid off-path
+        remaining = clock.duration_to_next_slot()
+        seconds_per_slot = getattr(clock, "seconds_per_slot", 12)
+        if remaining < seconds_per_slot / 4:
+            try:
+                chain.advance_head_state_to(slot + 1)
+            except Exception:
+                pass
+        stop.wait(min(1.0, max(0.05, remaining)))
 
 
 def _minimal() -> ChainSpec:

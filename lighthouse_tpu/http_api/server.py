@@ -628,17 +628,13 @@ class BeaconApiServer:
         if m:
             epoch = int(m.group(1))
             P = chain.preset
-            import copy as _copy
-
-            from ..state_transition.helpers import proposer_index_at_slot
-
             st = chain.head_state
             start = epoch * P.SLOTS_PER_EPOCH
-            if st.slot < start:
-                st = partial_state_advance(P, chain.spec, _copy.deepcopy(st), start)
+            proposers = chain.proposers_for_epoch(epoch)
             duties = []
-            for slot in range(start, start + P.SLOTS_PER_EPOCH):
-                proposer = proposer_index_at_slot(P, st, slot)
+            for slot, proposer in zip(
+                range(start, start + P.SLOTS_PER_EPOCH), proposers
+            ):
                 duties.append(
                     {
                         "pubkey": "0x"
