@@ -73,6 +73,21 @@ class Peer:
     def closed(self) -> bool:
         return self._closed
 
+    # -- server-side handler accounting ----------------------------------
+
+    def try_begin_handler(self) -> bool:
+        """Reserve a request-handler slot; False when the per-peer cap is
+        reached (caller should answer busy rather than queue unboundedly)."""
+        with self._state_lock:
+            if self._inflight_handlers >= MAX_INFLIGHT_HANDLERS:
+                return False
+            self._inflight_handlers += 1
+            return True
+
+    def end_handler(self) -> None:
+        with self._state_lock:
+            self._inflight_handlers -= 1
+
     def request(self, protocol: bytes, payload: bytes, timeout: float = 10.0) -> Optional[bytes]:
         """Any number of concurrent in-flight requests per peer, matched
         by request id (the reference multiplexes substreams the same way;
@@ -80,18 +95,21 @@ class Peer:
         backfill vs lookups — VERDICT r3 weak #6). A late answer to a
         timed-out request is dropped instead of satisfying a newer one."""
         ev = threading.Event()
+        entry = [ev, None]
         with self._state_lock:
             self._req_counter += 1
             rid = self._req_counter
-            self._pending[rid] = [ev, None]
+            self._pending[rid] = entry
         if not self.send(KIND_REQUEST, protocol, payload, req_id=rid):
             with self._state_lock:
                 self._pending.pop(rid, None)
             return None
         ok = ev.wait(timeout)
         with self._state_lock:
-            entry = self._pending.pop(rid, None)
-        return entry[1] if (ok and entry is not None) else None
+            self._pending.pop(rid, None)
+        # read from the LOCAL entry: a response recorded just before the
+        # peer closed must still be delivered (close() swaps the dict)
+        return entry[1] if ok else None
 
     # -- receiving -------------------------------------------------------
 
@@ -234,10 +252,12 @@ class Transport:
             # analogue) and (b) slow handlers for one peer never starve
             # another peer's requests (per-peer isolation, as when the
             # read loop itself served them)
-            with peer._state_lock:
-                if peer._inflight_handlers >= MAX_INFLIGHT_HANDLERS:
-                    return  # dropped: requester times out and backs off
-                peer._inflight_handlers += 1
+            if not peer.try_begin_handler():
+                # busy: answer empty immediately (the reference returns an
+                # RPC error) so the requester fails fast instead of riding
+                # out its timeout
+                peer.send(KIND_RESPONSE, name, b"", req_id=req_id)
+                return
             threading.Thread(
                 target=self._handle_request,
                 args=(peer, name, payload, req_id),
@@ -252,8 +272,7 @@ class Transport:
                 resp = b""
             peer.send(KIND_RESPONSE, name, resp or b"", req_id=req_id)
         finally:
-            with peer._state_lock:
-                peer._inflight_handlers -= 1
+            peer.end_handler()
 
     # -- broadcast -------------------------------------------------------
 
