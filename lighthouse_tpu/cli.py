@@ -61,10 +61,33 @@ def build_parser() -> argparse.ArgumentParser:
     w = am_sub.add_parser("wallet-create")
     w.add_argument("--name", required=True)
     w.add_argument("--out", required=True)
+    w.add_argument("--kdf-work", type=int, default=None,
+                   help="scrypt work factor override (tests/low-memory)")
     v = am_sub.add_parser("validator-create")
     v.add_argument("--wallet", required=True)
     v.add_argument("--out-dir", required=True)
     v.add_argument("--count", type=int, default=1)
+    v.add_argument("--kdf-work", type=int, default=None)
+    d = am_sub.add_parser(
+        "validator-deposits",
+        help="build DepositData (launchpad deposit_data.json) from keystores",
+    )
+    d.add_argument("--validator-dir", required=True)
+    d.add_argument("--out", required=True)
+    d.add_argument("--amount-gwei", type=int, default=32 * 10**9)
+    d.add_argument("--password", default=None, help="keystore password (else prompt)")
+    d.add_argument("--spec", choices=["mainnet", "minimal"], default="mainnet")
+    x = am_sub.add_parser(
+        "validator-exit", help="sign (and optionally publish) a voluntary exit"
+    )
+    x.add_argument("--keystore", required=True)
+    x.add_argument("--validator-index", type=int, required=True)
+    x.add_argument("--epoch", type=int, required=True)
+    x.add_argument("--genesis-validators-root", required=True, help="0x-hex root")
+    x.add_argument("--out", required=True)
+    x.add_argument("--password", default=None)
+    x.add_argument("--spec", choices=["mainnet", "minimal"], default="mainnet")
+    x.add_argument("--beacon-url", default=None, help="POST the exit to this BN")
 
     bnode = sub.add_parser("boot-node", help="standalone peer-exchange bootstrap server")
     bnode.add_argument("--port", type=int, default=9000)
@@ -91,11 +114,27 @@ def build_parser() -> argparse.ArgumentParser:
     tb.add_argument("--blocks", nargs="+", required=True)
     tb.add_argument("--out", required=True)
 
+    nt = lcli_sub.add_parser(
+        "new-testnet", help="write a testnet directory (config + genesis)"
+    )
+    nt.add_argument("--preset", choices=["mainnet", "minimal"], default="minimal")
+    nt.add_argument("--validators", type=int, default=64)
+    nt.add_argument("--genesis-time", type=int, default=0)
+    nt.add_argument("--out-dir", dest="out_dir", required=True)
+
     db = sub.add_parser("db", help="database manager")
     _add_global_flags(db)
     db_sub = db.add_subparsers(dest="db_command", required=True)
     inspect = db_sub.add_parser("inspect")
     inspect.add_argument("--datadir", default=None)
+    ver = db_sub.add_parser("version", help="print the on-disk schema version")
+    ver.add_argument("--datadir", required=True)
+    mig = db_sub.add_parser("migrate", help="migrate the store to the latest schema")
+    mig.add_argument("--datadir", required=True)
+    pru = db_sub.add_parser(
+        "prune", help="drop redundant pre-split hot snapshots + compact"
+    )
+    pru.add_argument("--datadir", required=True)
 
     return top
 
@@ -176,7 +215,7 @@ def run_am(args) -> int:
 
     if args.am_command == "wallet-create":
         password = getpass.getpass("wallet password: ")
-        w = Wallet.create(args.name, password)
+        w = Wallet.create(args.name, password, kdf_work=args.kdf_work)
         with open(args.out, "w") as f:
             json.dump(w.json, f, indent=2)
         print(f"wallet written to {args.out}")
@@ -191,7 +230,7 @@ def run_am(args) -> int:
         ks_pw = getpass.getpass("keystore password: ")
         os.makedirs(args.out_dir, exist_ok=True)
         for _ in range(args.count):
-            signing, withdrawal = w.next_validator(wallet_pw, ks_pw)
+            signing, withdrawal = w.next_validator(wallet_pw, ks_pw, kdf_work=args.kdf_work)
             stem = signing["pubkey"][:12]
             save(signing, f"{args.out_dir}/keystore-{stem}.json")
             save(withdrawal, f"{args.out_dir}/withdrawal-{stem}.json")
@@ -199,7 +238,125 @@ def run_am(args) -> int:
         with open(args.wallet, "w") as f:
             json.dump(w.json, f, indent=2)
         return 0
+    if args.am_command == "validator-deposits":
+        return _am_validator_deposits(args)
+    if args.am_command == "validator-exit":
+        return _am_validator_exit(args)
     return 1
+
+
+def _am_spec(name: str):
+    from .types.chain_spec import mainnet_spec, minimal_spec
+
+    return minimal_spec() if name == "minimal" else mainnet_spec()
+
+
+def _am_validator_deposits(args) -> int:
+    """DepositData per keystore in --validator-dir (reference
+    ``account_manager`` deposit creation; EF launchpad deposit_data.json
+    shape: signed DepositMessage under DOMAIN_DEPOSIT with a zeroed
+    genesis_validators_root)."""
+    import getpass
+    import glob
+    import os
+
+    from .crypto import bls
+    from .keys.keystore import decrypt, load
+    from .ssz import hash_tree_root
+    from .types.chain_spec import DOMAIN_DEPOSIT
+    from .types.containers import types_for
+    from .types.domains import compute_domain, compute_signing_root
+    from .types.preset import PRESETS
+
+    spec = _am_spec(args.spec)
+    t = types_for(PRESETS[args.spec])
+    password = args.password or getpass.getpass("keystore password: ")
+    out = []
+    paths = sorted(glob.glob(os.path.join(args.validator_dir, "keystore-*.json")))
+    if not paths:
+        print("no keystore-*.json files found", file=sys.stderr)
+        return 1
+    for path in paths:
+        ks = load(path)
+        sk = bls.SecretKey.deserialize(decrypt(ks, password))
+        pubkey = sk.public_key().serialize()
+        # BLS withdrawal credentials: 0x00 || sha256(pubkey)[1:]
+        import hashlib as _hashlib
+
+        cred = b"\x00" + _hashlib.sha256(pubkey).digest()[1:]
+        msg = t.DepositMessage(
+            pubkey=pubkey, withdrawal_credentials=cred, amount=args.amount_gwei
+        )
+        domain = compute_domain(
+            spec, DOMAIN_DEPOSIT, spec.genesis_fork_version, bytes(32)
+        )
+        root = compute_signing_root(t.DepositMessage, msg, domain)
+        sig = sk.sign(root).serialize()
+        dd = t.DepositData(
+            pubkey=pubkey, withdrawal_credentials=cred,
+            amount=args.amount_gwei, signature=sig,
+        )
+        out.append(
+            {
+                "pubkey": pubkey.hex(),
+                "withdrawal_credentials": cred.hex(),
+                "amount": args.amount_gwei,
+                "signature": sig.hex(),
+                "deposit_message_root": root.hex(),
+                "deposit_data_root": hash_tree_root(t.DepositData, dd).hex(),
+                "fork_version": spec.genesis_fork_version.hex(),
+            }
+        )
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=2)
+    print(f"wrote {len(out)} deposit(s) to {args.out}")
+    return 0
+
+
+def _am_validator_exit(args) -> int:
+    """Sign a VoluntaryExit offline (reference ``account_manager`` exit):
+    domain from DOMAIN_VOLUNTARY_EXIT at --epoch against the supplied
+    genesis validators root; optional publish to --beacon-url."""
+    import getpass
+
+    from .crypto import bls
+    from .keys.keystore import decrypt, load
+    from .types.chain_spec import DOMAIN_VOLUNTARY_EXIT
+    from .types.containers import types_for
+    from .types.domains import compute_domain, compute_signing_root
+    from .types.preset import PRESETS
+
+    spec = _am_spec(args.spec)
+    t = types_for(PRESETS[args.spec])
+    password = args.password or getpass.getpass("keystore password: ")
+    sk = bls.SecretKey.deserialize(decrypt(load(args.keystore), password))
+    gvr = bytes.fromhex(args.genesis_validators_root.removeprefix("0x"))
+    exit_msg = t.VoluntaryExit(epoch=args.epoch, validator_index=args.validator_index)
+    domain = compute_domain(
+        spec, DOMAIN_VOLUNTARY_EXIT, spec.fork_version_at_epoch(args.epoch), gvr
+    )
+    root = compute_signing_root(t.VoluntaryExit, exit_msg, domain)
+    signed = t.SignedVoluntaryExit(
+        message=exit_msg, signature=sk.sign(root).serialize()
+    )
+    from .ssz.json import to_json
+
+    doc = to_json(t.SignedVoluntaryExit, signed)
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=2)
+    print(f"wrote signed exit for validator {args.validator_index} to {args.out}")
+    if args.beacon_url:
+        import urllib.request
+
+        req = urllib.request.Request(
+            args.beacon_url.rstrip("/") + "/eth/v1/beacon/pool/voluntary_exits",
+            data=json.dumps(doc).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            print(f"published: HTTP {r.status}")
+    return 0
 
 
 def run_boot_node(args) -> int:
@@ -306,6 +463,36 @@ def run_lcli(args) -> int:
         obj = tpe.decode(raw)
         print(json.dumps(to_json(tpe, obj), indent=2))
         return 0
+    if args.lcli_command == "new-testnet":
+        import os as _os
+
+        import yaml as _yaml
+
+        _os.makedirs(args.out_dir, exist_ok=True)
+        st = interop_genesis_state(
+            preset, spec, args.validators, genesis_time=args.genesis_time
+        )
+        write_state(f"{args.out_dir}/genesis.ssz", st)
+        cfg = {
+            "PRESET_BASE": args.preset,
+            "MIN_GENESIS_TIME": int(args.genesis_time),
+            "GENESIS_FORK_VERSION": "0x" + spec.genesis_fork_version.hex(),
+            "SECONDS_PER_SLOT": int(spec.seconds_per_slot),
+            "GENESIS_VALIDATORS_ROOT": "0x"
+            + bytes(st.genesis_validators_root).hex(),
+            "MIN_PER_EPOCH_CHURN_LIMIT": int(spec.min_per_epoch_churn_limit),
+            "CHURN_LIMIT_QUOTIENT": int(spec.churn_limit_quotient),
+            "EJECTION_BALANCE": int(spec.ejection_balance),
+        }
+        with open(f"{args.out_dir}/config.yaml", "w") as f:
+            _yaml.safe_dump(cfg, f)
+        with open(f"{args.out_dir}/boot_nodes.yaml", "w") as f:
+            _yaml.safe_dump([], f)
+        print(
+            f"testnet dir {args.out_dir}: genesis.ssz "
+            f"({args.validators} validators), config.yaml, boot_nodes.yaml"
+        )
+        return 0
     return 1
 
 
@@ -327,7 +514,73 @@ def run_db(args) -> int:
         head = kv.get(Column.METADATA, b"head")
         print(f"head: {head.hex() if head else None}")
         return 0
+    if args.db_command == "version":
+        kv = SqliteStore(f"{args.datadir}/chain.sqlite")
+        print(f"schema version: {_db_schema_version(kv)}")
+        return 0
+    if args.db_command == "migrate":
+        kv = SqliteStore(f"{args.datadir}/chain.sqlite")
+        v = _db_schema_version(kv)
+        for target, fn in sorted(_DB_MIGRATIONS.items()):
+            if v < target:
+                fn(kv)
+                kv.put(Column.METADATA, b"schema", str(target).encode())
+                print(f"migrated v{v} -> v{target}")
+                v = target
+        print(f"store at schema v{v} (latest {DB_SCHEMA_LATEST})")
+        return 0
+    if args.db_command == "prune":
+        import struct as _struct
+
+        kv = SqliteStore(f"{args.datadir}/chain.sqlite")
+        raw = kv.get(Column.METADATA, b"split")
+        split = _struct.unpack("<Q", raw)[0] if raw else 0
+        # pre-split hot snapshots are redundant once migrated to the
+        # freezer (reference database_manager prune-states); the head
+        # state is safe because head slot >= split always holds
+        dropped = 0
+        for key in list(kv.keys(Column.STATE)):
+            data = kv.get(Column.STATE, key)
+            if data is None:
+                continue
+            # every BeaconState starts [fork_id u8][genesis_time u64]
+            # [genesis_validators_root 32][slot u64]
+            slot = int.from_bytes(data[1 + 8 + 32 : 1 + 8 + 32 + 8], "little")
+            if slot and slot < split:
+                kv.delete(Column.STATE, key)
+                dropped += 1
+        # pre-split summaries must go WITH their base snapshots: a kept
+        # summary whose replay chain bottoms out in a deleted snapshot
+        # would fail to load (StateSummary starts [slot u64])
+        for key in list(kv.keys(Column.STATE_SUMMARY)):
+            data = kv.get(Column.STATE_SUMMARY, key)
+            if data is None:
+                continue
+            slot = int.from_bytes(data[:8], "little")
+            if slot and slot < split:
+                kv.delete(Column.STATE_SUMMARY, key)
+                dropped += 1
+        try:
+            kv._conn.execute("VACUUM")
+        except Exception:
+            pass
+        print(f"dropped {dropped} pre-split hot snapshots (split slot {split})")
+        return 0
     return 1
+
+
+DB_SCHEMA_LATEST = 1
+# target version -> migration fn(kv); v1 is the current layout, so the
+# table is empty — the framework (version stamp + ordered apply) mirrors
+# the reference's schema_change.rs
+_DB_MIGRATIONS: dict = {}
+
+
+def _db_schema_version(kv) -> int:
+    from .store import Column
+
+    raw = kv.get(Column.METADATA, b"schema")
+    return int(raw.decode()) if raw else 1
 
 
 def main(argv=None) -> int:

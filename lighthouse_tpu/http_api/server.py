@@ -32,6 +32,12 @@ consume):
     GET  /eth/v1/validator/attestation_data
     GET  /eth/v1/validator/aggregate_attestation
     POST /eth/v1/validator/aggregate_and_proofs
+    GET  /eth/v1/validator/blinded_blocks/{slot}
+    POST /eth/v1/beacon/blinded_blocks
+    GET  /eth/v1/beacon/rewards/blocks/{block_id}
+    POST /eth/v1/beacon/rewards/attestations/{epoch}
+    POST /eth/v1/validator/liveness/{epoch}
+    GET  /eth/v1/node/peer_count
     GET  /metrics
 """
 
@@ -70,6 +76,14 @@ class BeaconApiServer:
 
     def __init__(self, chain, host: str = "127.0.0.1", port: int = 5052):
         self.chain = chain
+        # blinded-block flow: payload-header root -> full payload, filled
+        # at blinded production, consumed (popped) at blinded submission
+        # (the in-process stand-in for the builder's payload reveal);
+        # bounded FIFO so polling production cannot leak payloads
+        from collections import OrderedDict as _OD
+
+        self._payload_cache: dict = _OD()
+        self._payload_cache_cap = 8
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -698,6 +712,109 @@ class BeaconApiServer:
                 "version": fork_of(chain.head_state),
                 "data": to_json(type(block), block),
             }
+
+        m = re.fullmatch(r"/eth/v1/validator/blinded_blocks/(\d+)", path)
+        if m:
+            # blinded production (reference http_api blinded-block routes +
+            # builder flow): bellatrix payloads are replaced by their
+            # header; the full payload is cached for the submit leg
+            slot = int(m.group(1))
+            randao = bytes.fromhex(query["randao_reveal"][2:])
+            graffiti = (
+                bytes.fromhex(query["graffiti"][2:])
+                if "graffiti" in query
+                else bytes(32)
+            )
+            block, _proposer = chain.produce_block_on_state(slot, randao, graffiti)
+            fork = fork_of(chain.head_state)
+            if fork != "bellatrix":
+                return {"version": fork, "data": to_json(type(block), block)}
+            blinded, payload = _blind_block(t, block)
+            header = blinded.body.execution_payload_header
+            self._payload_cache[
+                hash_tree_root(t.ExecutionPayloadHeader, header)
+            ] = payload
+            while len(self._payload_cache) > self._payload_cache_cap:
+                self._payload_cache.popitem(last=False)
+            return {
+                "version": fork,
+                "data": to_json(t.BlindedBeaconBlockBellatrix, blinded),
+            }
+
+        if path == "/eth/v1/beacon/blinded_blocks" and method == "POST":
+            payload_json = (
+                body["data"] if isinstance(body, dict) and "data" in body else body
+            )
+            fork = fork_of(chain.head_state)
+            if fork != "bellatrix":
+                sb = from_json(t.signed_block[fork], payload_json)
+            else:
+                sbb = from_json(t.SignedBlindedBeaconBlockBellatrix, payload_json)
+                header = sbb.message.body.execution_payload_header
+                payload = self._payload_cache.pop(
+                    hash_tree_root(t.ExecutionPayloadHeader, header), None
+                )
+                if payload is None:
+                    raise ApiError(400, "unknown payload header (not produced here)")
+                bb = sbb.message
+                full_body = t.block_body["bellatrix"](
+                    **{
+                        name: getattr(bb.body, name)
+                        for name, _ in t.BlindedBeaconBlockBodyBellatrix.fields
+                        if name != "execution_payload_header"
+                    },
+                    execution_payload=payload,
+                )
+                full = t.block["bellatrix"](
+                    slot=bb.slot,
+                    proposer_index=bb.proposer_index,
+                    parent_root=bb.parent_root,
+                    state_root=bb.state_root,
+                    body=full_body,
+                )
+                sb = t.signed_block["bellatrix"](
+                    message=full, signature=sbb.signature
+                )
+            try:
+                chain.process_block(sb)
+            except Exception as e:
+                raise ApiError(400, f"block rejected: {e}")
+            return None
+
+        m = re.fullmatch(r"/eth/v1/beacon/rewards/blocks/([^/]+)", path)
+        if m:
+            return _block_rewards(chain, t, *self._block_for(m.group(1)))
+
+        m = re.fullmatch(r"/eth/v1/beacon/rewards/attestations/(\d+)", path)
+        if m and method == "POST":
+            return _attestation_rewards(
+                chain, t, int(m.group(1)), body or []
+            )
+
+        m = re.fullmatch(r"/eth/v1/validator/liveness/(\d+)", path)
+        if m and method == "POST":
+            epoch = int(m.group(1))
+            out = []
+            for idx in body or []:
+                v = int(idx)
+                live = (
+                    chain.observed_attesters.is_known(v, epoch)
+                    or chain.observed_aggregators.is_known(v, epoch)
+                )
+                out.append({"index": str(v), "is_live": bool(live)})
+            return {"data": out}
+
+        if path == "/eth/v1/node/peer_count":
+            net = getattr(chain, "network", None)
+            n = net.transport.peer_count() if net is not None else 0
+            return {
+                "data": {
+                    "disconnected": "0",
+                    "connecting": "0",
+                    "connected": str(n),
+                    "disconnecting": "0",
+                }
+            }
         if path == "/eth/v1/validator/attestation_data":
             slot = int(query["slot"])
             index = int(query["committee_index"])
@@ -864,3 +981,149 @@ def _best_aggregate(chain, slot: int, data_root: bytes):
             data=data,
             signature=best.signature,
         )
+
+
+def _blind_block(t, block):
+    """Full bellatrix block -> (blinded block, extracted payload).
+    The header's transactions_root commits to the withheld payload."""
+    payload = block.body.execution_payload
+    header = t.ExecutionPayloadHeader(
+        **{
+            name: getattr(payload, name)
+            for name, _ in t.ExecutionPayloadHeader.fields
+            if name != "transactions_root"
+        },
+        transactions_root=hash_tree_root(
+            dict(t.ExecutionPayload.fields)["transactions"], payload.transactions
+        ),
+    )
+    body = t.BlindedBeaconBlockBodyBellatrix(
+        **{
+            name: getattr(block.body, name)
+            for name, _ in t.BlindedBeaconBlockBodyBellatrix.fields
+            if name != "execution_payload_header"
+        },
+        execution_payload_header=header,
+    )
+    blinded = t.BlindedBeaconBlockBellatrix(
+        slot=block.slot,
+        proposer_index=block.proposer_index,
+        parent_root=block.parent_root,
+        state_root=block.state_root,
+        body=body,
+    )
+    return blinded, payload
+
+
+def _block_rewards(chain, t, root, signed_block):
+    """Proposer reward decomposition for one block (reference http_api
+    block-rewards route): each component measured as the proposer-balance
+    delta of applying exactly that op class with the REAL op processors —
+    no formula duplication to drift."""
+    import copy as _copy
+
+    from ..state_transition import block as st_block
+    from ..state_transition import partial_state_advance
+    from ..state_transition.block import (
+        state_pubkey_bytes_resolver,
+        state_pubkey_resolver,
+    )
+
+    block = signed_block.message
+    preset, spec = chain.preset, chain.spec
+    parent = chain.state_at_block_root(bytes(block.parent_root))
+    state = partial_state_advance(
+        preset, spec, _copy.deepcopy(parent), int(block.slot)
+    )
+    fork = fork_of(state)
+    proposer = int(block.proposer_index)
+    resolver = state_pubkey_resolver(state)
+
+    def bal() -> int:
+        return int(state.balances[proposer])
+
+    components = {}
+    b0 = bal()
+    for ps in block.body.proposer_slashings:
+        st_block.process_proposer_slashing(preset, spec, state, ps, fork, False, resolver)
+    components["proposer_slashings"] = bal() - b0
+    b0 = bal()
+    for asl in block.body.attester_slashings:
+        st_block.process_attester_slashing(preset, spec, state, asl, fork, False, resolver)
+    components["attester_slashings"] = bal() - b0
+    b0 = bal()
+    for att in block.body.attestations:
+        st_block.process_attestation(preset, spec, state, att, fork, False, resolver)
+    components["attestations"] = bal() - b0
+    components["sync_aggregate"] = 0
+    if fork != "phase0":
+        # spec definition: proposer_reward per included bit — NOT the raw
+        # proposer-balance delta, which on small committees also contains
+        # the proposer's own participant reward
+        _, proposer_per_bit = st_block.sync_aggregate_rewards(preset, state)
+        n_bits = sum(
+            1 for b in block.body.sync_aggregate.sync_committee_bits if b
+        )
+        components["sync_aggregate"] = proposer_per_bit * n_bits
+        st_block.process_sync_aggregate(
+            preset, spec, state, int(block.slot), block.body.sync_aggregate,
+            False, state_pubkey_bytes_resolver(state),
+        )
+    return {
+        "execution_optimistic": False,
+        "finalized": False,
+        "data": {
+            "proposer_index": str(proposer),
+            "total": str(sum(components.values())),
+            "attestations": str(components["attestations"]),
+            "sync_aggregate": str(components["sync_aggregate"]),
+            "proposer_slashings": str(components["proposer_slashings"]),
+            "attester_slashings": str(components["attester_slashings"]),
+        },
+    }
+
+
+def _attestation_rewards(chain, t, epoch: int, indices) -> dict:
+    """Attestation rewards for ``epoch`` (reference http_api
+    attestation-rewards route): per-validator source/target/head +
+    inactivity from the columnar reward kernels, computed on a state
+    whose PREVIOUS epoch is the requested one."""
+    from ..state_transition.helpers import compute_epoch_at_slot
+    from ..state_transition.state.epoch import altair_reward_components
+
+    state = chain.head_state
+    if fork_of(state) == "phase0":
+        raise ApiError(501, "attestation rewards: altair+ only")
+    cur = compute_epoch_at_slot(chain.preset, state.slot)
+    # rewards for epoch E are defined once E is the PREVIOUS epoch of a
+    # completed head (advancing a copy cannot conjure the attestations,
+    # and an unbounded requested epoch would be a remote CPU sink)
+    if cur < epoch + 1:
+        raise ApiError(400, f"epoch {epoch} is not yet complete (current {cur})")
+    if cur > epoch + 1:
+        raise ApiError(501, "historical attestation rewards not supported")
+    comp = altair_reward_components(chain.preset, chain.spec, state)
+    want = [int(i) for i in indices] if indices else [
+        i for i in range(len(state.validators)) if comp["eligible"][i]
+    ]
+    total = [
+        {
+            "validator_index": str(i),
+            "head": str(int(comp["head"][i])),
+            "target": str(int(comp["target"][i])),
+            "source": str(int(comp["source"][i])),
+            "inactivity": str(int(comp["inactivity"][i])),
+        }
+        for i in want
+    ]
+    ideal = [
+        {
+            "effective_balance": str(eff),
+            "head": str(int(v["head"])),
+            "target": str(int(v["target"])),
+            "source": str(int(v["source"])),
+            "inactivity": "0",
+        }
+        for eff, v in sorted(comp["ideal"].items())
+    ]
+    return {"data": {"ideal_rewards": ideal, "total_rewards": total}}

@@ -497,17 +497,10 @@ def process_voluntary_exit(
     initiate_validator_exit(preset, spec, state, exit_msg.validator_index)
 
 
-def process_sync_aggregate(
-    preset: Preset, spec: ChainSpec, state, slot: int, sync_aggregate, verify: bool,
-    by_bytes,
-) -> None:
-    if verify:
-        s = sigsets.sync_aggregate_set(
-            preset, spec, state, slot, sync_aggregate, by_bytes
-        )
-        if s is not None:
-            _verify_set(s, "sync aggregate")
-
+def sync_aggregate_rewards(preset: Preset, state) -> tuple[int, int]:
+    """Spec sync-aggregate reward pair: (participant_reward,
+    proposer_reward per included bit) — shared by process_sync_aggregate
+    and the Beacon API block-rewards route."""
     total_active_increments = (
         get_total_active_balance(preset, state) // preset.EFFECTIVE_BALANCE_INCREMENT
     )
@@ -527,6 +520,21 @@ def process_sync_aggregate(
     proposer_reward = (
         participant_reward * PROPOSER_WEIGHT // (WEIGHT_DENOMINATOR - PROPOSER_WEIGHT)
     )
+    return participant_reward, proposer_reward
+
+
+def process_sync_aggregate(
+    preset: Preset, spec: ChainSpec, state, slot: int, sync_aggregate, verify: bool,
+    by_bytes,
+) -> None:
+    if verify:
+        s = sigsets.sync_aggregate_set(
+            preset, spec, state, slot, sync_aggregate, by_bytes
+        )
+        if s is not None:
+            _verify_set(s, "sync aggregate")
+
+    participant_reward, proposer_reward = sync_aggregate_rewards(preset, state)
 
     pubkey_to_index = {v.pubkey: i for i, v in enumerate(state.validators)}
     proposer = get_beacon_proposer_index(preset, state)

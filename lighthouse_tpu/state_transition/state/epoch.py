@@ -155,6 +155,96 @@ def process_epoch_columnar(preset: Preset, spec: ChainSpec, state) -> None:
         sc.process_sync_committee_updates(preset, state)
 
 
+def altair_reward_components(preset: Preset, spec: ChainSpec, state) -> dict:
+    """Per-validator PREVIOUS-epoch attestation reward components for the
+    Beacon API rewards endpoint (reference ``http_api`` attestation
+    rewards; computed with the same columnar kernels as the live epoch
+    transition). Pure: works on an internal copy.
+
+    Returns arrays (len = validator count): ``source``/``target``/``head``
+    (signed: reward if participating, -penalty if not), ``inactivity``
+    (<= 0), plus ``eligible`` (bool) and ``ideal`` — a map of effective
+    balance -> ideal (full-participation) source/target/head rewards."""
+    import copy as _copy
+
+    from .. import epoch as sc
+    from ..helpers import get_current_epoch, get_previous_epoch, integer_squareroot
+
+    st = _copy.deepcopy(state)
+    cols = Columns.from_state(st)
+    n = cols.n
+    cur = get_current_epoch(preset, st)
+    prev = get_previous_epoch(preset, st)
+    active_prev = cols.active_mask(prev)
+    active_cur = cols.active_mask(cur)
+    total = cols.total_active_balance(preset, cur)
+    eligible = active_prev | (cols.slashed & (np.uint64(prev + 1) < cols.wd))
+    prev_part = np.fromiter(st.previous_epoch_participation, np.uint8, count=n)
+    cur_part = np.fromiter(st.current_epoch_participation, np.uint8, count=n)
+    scores = np.fromiter(st.inactivity_scores, np.int64, count=n)
+
+    # replicate the pass order on the copy: justification first (the leak
+    # flag reads the updated finalized checkpoint), then score updates
+    if cur > _GENESIS_EPOCH + 1:
+        unslashed_prev_tgt = (
+            active_prev & ~cols.slashed & _flag_mask(prev_part, sc.TIMELY_TARGET_FLAG_INDEX)
+        )
+        unslashed_cur_tgt = (
+            active_cur & ~cols.slashed & _flag_mask(cur_part, sc.TIMELY_TARGET_FLAG_INDEX)
+        )
+        sc._weigh_justification_and_finalization(
+            preset, st,
+            cols.sum_effective(preset, unslashed_prev_tgt),
+            cols.sum_effective(preset, unslashed_cur_tgt),
+        )
+    finality_delay = prev - st.finalized_checkpoint.epoch
+    in_leak = finality_delay > preset.MIN_EPOCHS_TO_INACTIVITY_PENALTY
+    unslashed_prev_tgt = (
+        active_prev & ~cols.slashed & _flag_mask(prev_part, sc.TIMELY_TARGET_FLAG_INDEX)
+    )
+    scores = _inactivity_updates(spec, scores, eligible, unslashed_prev_tgt, in_leak)
+
+    inc = preset.EFFECTIVE_BALANCE_INCREMENT
+    base_per_increment = inc * preset.BASE_REWARD_FACTOR // integer_squareroot(total)
+    base = (cols.eff // inc) * base_per_increment
+    active_increments = total // inc
+    out = {"eligible": eligible, "ideal": {}}
+    names = {0: "source", 1: "target", 2: "head"}
+    distinct_eff = sorted({int(e) for e in cols.eff[eligible]}) if n else []
+    for eff in distinct_eff:
+        out["ideal"][eff] = {}
+    for flag_index, weight in enumerate(sc.PARTICIPATION_FLAG_WEIGHTS):
+        unslashed = active_prev & ~cols.slashed & _flag_mask(prev_part, flag_index)
+        ui = cols.sum_effective(preset, unslashed) // inc
+        comp = np.zeros(n, np.int64)
+        if not in_leak:
+            numerator = base * (weight * ui)
+            comp[unslashed] = numerator[unslashed] // (
+                active_increments * sc.WEIGHT_DENOMINATOR
+            )
+        if flag_index != sc.TIMELY_HEAD_FLAG_INDEX:
+            miss = eligible & ~unslashed
+            comp[miss] = -((base[miss] * weight) // sc.WEIGHT_DENOMINATOR)
+        out[names[flag_index]] = comp
+        for eff in distinct_eff:
+            b = eff // inc * base_per_increment
+            out["ideal"][eff][names[flag_index]] = (
+                0 if in_leak else b * weight * ui // (active_increments * sc.WEIGHT_DENOMINATOR)
+            )
+    quotient = (
+        preset.INACTIVITY_PENALTY_QUOTIENT_ALTAIR
+        if sc.fork_of(st) == "altair"
+        else preset.INACTIVITY_PENALTY_QUOTIENT_BELLATRIX
+    )
+    inact = np.zeros(n, np.int64)
+    miss_tgt = eligible & ~unslashed_prev_tgt
+    inact[miss_tgt] = -(
+        (cols.eff[miss_tgt] * scores[miss_tgt]) // (spec.inactivity_score_bias * quotient)
+    )
+    out["inactivity"] = inact
+    return out
+
+
 # ---------------------------------------------------------------------------
 # pure pre-mutation bound checks (Fallback may only come from these)
 # ---------------------------------------------------------------------------

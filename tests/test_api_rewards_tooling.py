@@ -1,0 +1,265 @@
+"""Round-4 operator-surface additions (VERDICT r3 #8): blinded-block
+production/submission, block + attestation rewards, liveness, peer_count
+routes; am validator-deposits/validator-exit; db version/migrate/prune;
+lcli new-testnet."""
+
+import copy
+import json
+import urllib.request
+
+import pytest
+
+from lighthouse_tpu.beacon_chain import BeaconChain
+from lighthouse_tpu.crypto import backend
+from lighthouse_tpu.http_api import BeaconApiServer
+from lighthouse_tpu.operation_pool import OperationPool
+from lighthouse_tpu.state_transition import store_replayer
+from lighthouse_tpu.store import HotColdDB, MemoryStore
+from lighthouse_tpu.testing.harness import StateHarness
+from lighthouse_tpu.types.chain_spec import minimal_spec
+from lighthouse_tpu.types.preset import MINIMAL
+from lighthouse_tpu.utils.slot_clock import ManualSlotClock
+
+
+@pytest.fixture(autouse=True)
+def fake_backend():
+    backend.set_backend("fake")
+    yield
+    backend.set_backend("cpu")
+
+
+def _mk_node(fork="altair", n=8):
+    spec = minimal_spec(
+        altair_fork_epoch=0 if fork != "phase0" else None,
+        bellatrix_fork_epoch=0 if fork == "bellatrix" else None,
+    )
+    h = StateHarness(MINIMAL, spec, validator_count=n, fork_name=fork, fake_sign=True)
+    genesis = copy.deepcopy(h.state)
+    db = HotColdDB(MemoryStore(), h.t, h.spec, store_replayer(h.preset, h.spec))
+    clock = ManualSlotClock(genesis.genesis_time, h.spec.seconds_per_slot)
+    chain = BeaconChain(h.preset, h.spec, h.t, db, genesis, slot_clock=clock)
+    chain.op_pool = OperationPool(h.preset, h.spec, h.t)
+    return h, chain, clock
+
+
+def _get(server, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{server.port}{path}", timeout=10
+    ) as r:
+        return json.loads(r.read())
+
+
+def _post(server, path, body):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}{path}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        raw = r.read()
+        return json.loads(raw) if raw else None
+
+
+def _grow(h, chain, clock, n_slots):
+    for _ in range(n_slots):
+        slot = h.state.slot + 1
+        clock.set_slot(slot)
+        atts = (
+            h.attestations_for_slot(h.state, h.state.slot)[: MINIMAL.MAX_ATTESTATIONS]
+            if slot >= 2
+            else []
+        )
+        sb = h.produce_block(slot, attestations=atts)
+        h.process_block(sb, strategy="none")
+        chain.process_block(chain.verify_block_for_gossip(sb))
+
+
+def test_block_rewards_route():
+    h, chain, clock = _mk_node("altair")
+    server = BeaconApiServer(chain, port=0).start()
+    try:
+        _grow(h, chain, clock, 4)
+        out = _get(server, "/eth/v1/beacon/rewards/blocks/head")
+        data = out["data"]
+        assert int(data["proposer_index"]) < 8
+        total = int(data["total"])
+        assert total == (
+            int(data["attestations"]) + int(data["sync_aggregate"])
+            + int(data["proposer_slashings"]) + int(data["attester_slashings"])
+        )
+        assert int(data["attestations"]) > 0  # block carried attestations
+    finally:
+        server.stop()
+
+
+def test_attestation_rewards_route():
+    h, chain, clock = _mk_node("altair")
+    server = BeaconApiServer(chain, port=0).start()
+    try:
+        _grow(h, chain, clock, MINIMAL.SLOTS_PER_EPOCH + 2)
+        out = _post(server, "/eth/v1/beacon/rewards/attestations/0", [])
+        data = out["data"]
+        assert data["ideal_rewards"], "no ideal rewards tiers"
+        assert data["total_rewards"], "no per-validator rewards"
+        row = data["total_rewards"][0]
+        for key in ("validator_index", "head", "target", "source", "inactivity"):
+            assert key in row
+        # fully-participating minimal chain: positive target rewards
+        assert any(int(r["target"]) > 0 for r in data["total_rewards"])
+    finally:
+        server.stop()
+
+
+def test_liveness_and_peer_count_routes():
+    h, chain, clock = _mk_node("altair")
+    server = BeaconApiServer(chain, port=0).start()
+    try:
+        _grow(h, chain, clock, 3)
+        epoch = 0
+        chain.observed_attesters.observe(3, epoch)
+        out = _post(server, f"/eth/v1/validator/liveness/{epoch}", ["3", "5"])
+        by_idx = {r["index"]: r["is_live"] for r in out["data"]}
+        assert by_idx == {"3": True, "5": False}
+        pc = _get(server, "/eth/v1/node/peer_count")
+        assert pc["data"]["connected"] == "0"  # no network attached here
+    finally:
+        server.stop()
+
+
+def test_blinded_block_roundtrip_bellatrix():
+    h, chain, clock = _mk_node("bellatrix")
+    server = BeaconApiServer(chain, port=0).start()
+    try:
+        _grow(h, chain, clock, 2)
+        slot = int(h.state.slot) + 1
+        clock.set_slot(slot)
+        randao = h.randao_reveal(h.state, slot, 0)
+        out = _get(
+            server,
+            f"/eth/v1/validator/blinded_blocks/{slot}?randao_reveal=0x{randao.hex()}",
+        )
+        assert out["version"] == "bellatrix"
+        blinded = out["data"]
+        assert "execution_payload_header" in blinded["body"]
+        # sign the blinded message (its root == the full block's root,
+        # since the payload header commits to the payload) and submit;
+        # the server must reconstruct the payload from its cache and the
+        # block must pass the full state transition
+        t = h.t
+        from lighthouse_tpu.ssz.json import from_json
+
+        msg = from_json(t.BlindedBeaconBlockBellatrix, blinded)
+        signed = h.sign_block(msg, int(blinded["proposer_index"]))
+        sbb = {
+            "message": blinded,
+            "signature": "0x" + bytes(signed.signature).hex(),
+        }
+        _post(server, "/eth/v1/beacon/blinded_blocks", sbb)
+        # the chain imported it: head advanced to the submitted slot
+        head_block = chain.store.get_block(chain.head_block_root)
+        assert int(head_block.message.slot) == slot
+    finally:
+        server.stop()
+
+
+# -- CLI tooling ------------------------------------------------------------
+
+
+def test_am_deposits_and_exit(tmp_path):
+    from lighthouse_tpu.cli import main
+
+    wallet = tmp_path / "wallet.json"
+    vdir = tmp_path / "validators"
+    import unittest.mock as mock
+
+    with mock.patch("getpass.getpass", return_value="pw"):
+        assert main(["am", "wallet-create", "--name", "w", "--out", str(wallet), "--kdf-work", "1024"]) == 0
+        assert (
+            main([
+                "am", "validator-create", "--wallet", str(wallet),
+                "--out-dir", str(vdir), "--count", "2", "--kdf-work", "1024",
+            ])
+            == 0
+        )
+    deposits = tmp_path / "deposit_data.json"
+    assert (
+        main([
+            "am", "validator-deposits", "--validator-dir", str(vdir),
+            "--out", str(deposits), "--password", "pw", "--spec", "minimal",
+        ])
+        == 0
+    )
+    docs = json.loads(deposits.read_text())
+    assert len(docs) == 2
+    for d in docs:
+        assert len(bytes.fromhex(d["pubkey"])) == 48
+        assert bytes.fromhex(d["withdrawal_credentials"])[0] == 0
+        assert len(bytes.fromhex(d["signature"])) == 96
+        assert d["amount"] == 32 * 10**9
+
+    ks = sorted(vdir.glob("keystore-*.json"))[0]
+    exit_out = tmp_path / "exit.json"
+    assert (
+        main([
+            "am", "validator-exit", "--keystore", str(ks),
+            "--validator-index", "7", "--epoch", "3",
+            "--genesis-validators-root", "0x" + "11" * 32,
+            "--out", str(exit_out), "--password", "pw", "--spec", "minimal",
+        ])
+        == 0
+    )
+    doc = json.loads(exit_out.read_text())
+    assert doc["message"]["validator_index"] == "7"
+    assert doc["message"]["epoch"] == "3"
+    assert len(bytes.fromhex(doc["signature"][2:])) == 96
+
+
+def test_db_version_migrate_prune(tmp_path):
+    from lighthouse_tpu.cli import main
+    from lighthouse_tpu.store import Column, SqliteStore
+
+    # build a tiny datadir with pre-split snapshots
+    h, chain, clock = _mk_node("phase0")
+    _grow(h, chain, clock, 3)
+    kv = SqliteStore(f"{tmp_path}/chain.sqlite")
+    for root, state in [
+        (b"\x01" * 32, h.state),
+    ]:
+        data = bytes([1]) + type(state).encode(state)
+        kv.put(Column.STATE, root, data)
+    import struct
+
+    kv.put(Column.METADATA, b"split", struct.pack("<Q", int(h.state.slot) + 10))
+    kv.close()
+
+    assert main(["db", "version", "--datadir", str(tmp_path)]) == 0
+    assert main(["db", "migrate", "--datadir", str(tmp_path)]) == 0
+    assert main(["db", "prune", "--datadir", str(tmp_path)]) == 0
+    kv = SqliteStore(f"{tmp_path}/chain.sqlite")
+    assert kv.get(Column.STATE, b"\x01" * 32) is None, "pre-split snapshot kept"
+
+
+def test_lcli_new_testnet(tmp_path):
+    import yaml
+
+    from lighthouse_tpu.cli import main
+
+    out = tmp_path / "testnet"
+    assert (
+        main([
+            "lcli", "new-testnet", "--preset", "minimal", "--validators", "8",
+            "--genesis-time", "12345", "--out-dir", str(out),
+        ])
+        == 0
+    )
+    cfg = yaml.safe_load((out / "config.yaml").read_text())
+    assert cfg["PRESET_BASE"] == "minimal"
+    assert cfg["MIN_GENESIS_TIME"] == 12345
+    raw = (out / "genesis.ssz").read_bytes()
+    from lighthouse_tpu.types.containers import types_for
+
+    t = types_for(MINIMAL)
+    st = t.state["phase0"].decode(raw[1:])
+    assert len(st.validators) == 8
+    assert st.genesis_time == 12345
