@@ -123,6 +123,44 @@ def test_fallback_on_huge_balance():
     process_epoch(MINIMAL, h.spec, h.state)
 
 
+def test_sync_committee_selection_matches_spec_loop():
+    """The vectorized-permutation sync-committee selection must equal the
+    literal spec loop (per-index compute_shuffled_index + per-candidate
+    hashing)."""
+    import hashlib
+
+    from lighthouse_tpu.state_transition.epoch import (
+        get_current_epoch,
+        get_next_sync_committee_indices,
+    )
+    from lighthouse_tpu.state_transition.helpers import (
+        get_active_validator_indices,
+        get_seed,
+    )
+    from lighthouse_tpu.state_transition.shuffle import compute_shuffled_index
+
+    h = _harness("altair", n=24)
+    h.extend_chain(3, strategy="none")
+    state = h.state
+    P = MINIMAL
+    epoch = get_current_epoch(P, state) + 1
+    active = get_active_validator_indices(state, epoch)
+    count = len(active)
+    seed = get_seed(P, state, epoch, 7)
+    ref, i = [], 0
+    while len(ref) < P.SYNC_COMMITTEE_SIZE:
+        s = compute_shuffled_index(i % count, count, seed, P.SHUFFLE_ROUND_COUNT)
+        cand = active[s]
+        rb = hashlib.sha256(seed + (i // 32).to_bytes(8, "little")).digest()[i % 32]
+        if (
+            state.validators[cand].effective_balance * 255
+            >= P.MAX_EFFECTIVE_BALANCE * rb
+        ):
+            ref.append(cand)
+        i += 1
+    assert get_next_sync_committee_indices(P, state) == ref
+
+
 def test_fallback_leaves_state_untouched():
     h = _harness("altair")
     h.extend_chain(MINIMAL.SLOTS_PER_EPOCH - 2, strategy="none")
