@@ -1,0 +1,157 @@
+"""Gossipsub-style mesh control (reference:
+``lighthouse_network/src/service/`` gossipsub behaviour + the degree
+parameters in ``gossipsub_scoring_parameters.rs``).
+
+Per-topic overlay meshes with degree targets: a heartbeat GRAFTs the
+highest-scoring peers into under-full meshes and PRUNEs the
+lowest-scoring out of over-full ones; relayed messages are forwarded to
+mesh members only. Originated messages are flood-published (the
+reference enables flood-publish for its latency-critical topics), so
+mesh state bounds RELAY fan-out without ever gating first-hop delivery.
+
+Control wire: a direct (non-flooded) gossip frame on the reserved topic
+``_ctl`` with payload ``b"G"``/``b"P"`` + topic bytes — the
+multistream-free analogue of gossipsub's GRAFT/PRUNE control messages.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from .transport import KIND_GOSSIP
+
+CTL_TOPIC = "_ctl"
+GRAFT = b"G"
+PRUNE = b"P"
+
+
+class MeshRouter:
+    # degree targets sized for the in-process simulators (reference
+    # mainnet: D=8, D_low=6, D_high=12)
+    D_LOW = 2
+    D = 4
+    D_HIGH = 8
+    MAX_TOPICS = 256          # locally-tracked topics (subnets x forks fit)
+    PRUNE_BACKOFF_S = 30.0    # gossipsub prune backoff analogue
+
+    def __init__(self, service):
+        self.service = service
+        self._lock = threading.Lock()
+        # topic -> set of grafted peers; keys created ONLY by track()
+        # (recognized local topics), never by remote control frames
+        self.mesh: dict[str, set] = {}
+        # (id(peer), topic) -> monotonic time until which GRAFT is banned
+        self._backoff: dict[tuple[int, str], float] = {}
+
+    # -- routing ---------------------------------------------------------
+
+    def relay_peers(self, topic: str, exclude=None) -> list | None:
+        """Peers to forward a RELAYED message on ``topic`` to (sender
+        already removed), or None to flood (mesh too thin — the sender
+        does not count toward the delivery-trust threshold)."""
+        with self._lock:
+            members = [
+                p for p in self.mesh.get(topic, ())
+                if not p.closed and p is not exclude
+            ]
+        if len(members) < self.D_LOW:
+            return None
+        return members
+
+    # -- control ---------------------------------------------------------
+
+    def on_control(self, peer, payload: bytes) -> None:
+        if not payload:
+            return
+        import time as _time
+
+        action, topic = payload[:1], payload[1:].decode(errors="replace")
+        send_refusal = False
+        with self._lock:
+            members = self.mesh.get(topic)
+            if members is None:
+                # unknown topic: refuse — remote control frames must not
+                # create mesh state (junk-topic contamination would
+                # propagate via heartbeats otherwise)
+                if action == GRAFT:
+                    send_refusal = True
+            elif action == GRAFT:
+                if len(members) >= self.D_HIGH and peer not in members:
+                    send_refusal = True  # full: refuse symmetrically
+                else:
+                    members.add(peer)
+            elif action == PRUNE:
+                members.discard(peer)
+                self._backoff[(id(peer), topic)] = (
+                    _time.monotonic() + self.PRUNE_BACKOFF_S
+                )
+        if send_refusal:
+            self._send_ctl(peer, PRUNE, topic)
+
+    def _send_ctl(self, peer, action: bytes, topic: str) -> None:
+        try:
+            peer.send(KIND_GOSSIP, CTL_TOPIC.encode(), action + topic.encode())
+        except Exception:
+            pass
+
+    def track(self, topic: str) -> None:
+        """Make ``topic`` mesh-managed (called on first publish or first
+        RECOGNIZED receive — callers validate the topic)."""
+        if topic == CTL_TOPIC:
+            return
+        with self._lock:
+            if topic not in self.mesh and len(self.mesh) >= self.MAX_TOPICS:
+                return  # bounded; overflow topics just flood
+            self.mesh.setdefault(topic, set())
+
+    # -- maintenance -----------------------------------------------------
+
+    def heartbeat(self) -> None:
+        """Degree maintenance (gossipsub heartbeat analogue): drop closed
+        peers, GRAFT the best-scoring non-members up to D, PRUNE the
+        worst-scoring members down to D when above D_HIGH."""
+        transport = self.service.transport
+        pm = self.service.peer_manager
+        with transport._lock:
+            all_peers = list(transport.peers)
+        with self._lock:
+            topics = list(self.mesh.keys())
+        for topic in topics:
+            with self._lock:
+                members = {p for p in self.mesh.get(topic, ()) if not p.closed}
+                self.mesh[topic] = members
+                current = set(members)
+            if len(current) < self.D:
+                import time as _time
+
+                now = _time.monotonic()
+                with self._lock:
+                    self._backoff = {
+                        k: t for k, t in self._backoff.items() if t > now
+                    }
+                    backoff = dict(self._backoff)
+                candidates = sorted(
+                    (
+                        p for p in all_peers
+                        if p not in current
+                        and not p.closed
+                        and backoff.get((id(p), topic), 0) <= now
+                    ),
+                    key=lambda p: pm.score(p),
+                    reverse=True,
+                )
+                for p in candidates[: self.D - len(current)]:
+                    with self._lock:
+                        self.mesh[topic].add(p)
+                    self._send_ctl(p, GRAFT, topic)
+            elif len(current) > self.D_HIGH:
+                victims = sorted(current, key=lambda p: pm.score(p))
+                for p in victims[: len(current) - self.D]:
+                    with self._lock:
+                        self.mesh[topic].discard(p)
+                    self._send_ctl(p, PRUNE, topic)
+
+    def remove_peer(self, peer) -> None:
+        with self._lock:
+            for members in self.mesh.values():
+                members.discard(peer)

@@ -31,7 +31,7 @@ from ..state_transition.epoch import fork_of
 from ..types.domains import compute_fork_digest
 from ..utils import metrics
 from .peer_manager import PeerManager
-from .transport import Peer, Transport
+from .transport import KIND_GOSSIP, Peer, Transport
 
 _GOSSIP_RX = metrics.counter("network_gossip_received_total")
 _GOSSIP_TX = metrics.counter("network_gossip_published_total")
@@ -107,10 +107,21 @@ class NetworkService:
         self.transport.on_gossip = self._on_gossip
         self.transport.on_request = self._on_request
         self.transport.on_peer_connected = self._on_peer_connected
+        self.transport.on_peer_removed = (
+            lambda peer: self.mesh_router.remove_peer(peer)
+        )
         self.peer_manager = PeerManager()
         self.peer_manager.on_disconnect = lambda p: p.close()
         self._seen: dict[bytes, float] = {}  # gossip message-id dedup
         self._seen_lock = threading.Lock()
+        from .mesh import MeshRouter
+
+        self.mesh_router = MeshRouter(self)
+        self._mesh_stop = threading.Event()
+        self._mesh_thread = threading.Thread(
+            target=self._mesh_heartbeat_loop, daemon=True
+        )
+        self._mesh_thread.start()
         self.sync = RangeSync(self)
         self.backfill = BackfillSync(self)
         # the HTTP API's /node/identity + /node/peers read this
@@ -135,7 +146,16 @@ class NetworkService:
         return self.transport.dial(host, port)
 
     def close(self) -> None:
+        self._mesh_stop.set()
         self.transport.close()
+
+    def _mesh_heartbeat_loop(self) -> None:
+        # gossipsub heartbeat analogue (reference heartbeat_interval ~0.7s)
+        while not self._mesh_stop.wait(1.0):
+            try:
+                self.mesh_router.heartbeat()
+            except Exception:
+                pass
 
     # -- gossip out ------------------------------------------------------
 
@@ -185,6 +205,9 @@ class NetworkService:
     def _publish(self, topic: str, payload: bytes) -> None:
         self._mark_seen(topic, payload)
         _GOSSIP_TX.inc()
+        # originated messages flood-publish (reference flood_publish for
+        # latency-critical topics); the mesh bounds RELAY fan-out only
+        self.mesh_router.track(topic)
         self.transport.publish(topic, payload)
 
     # -- gossip in -------------------------------------------------------
@@ -247,8 +270,15 @@ class NetworkService:
         return done
 
     def _on_gossip(self, peer: Peer, topic: str, payload: bytes) -> None:
+        from .mesh import CTL_TOPIC
+
+        # rate limiting applies to control frames too: GRAFT/PRUNE spam
+        # must hit the same token bucket + penalties as any gossip
         if not self.peer_manager.allow_gossip(peer):
             return  # rate-limited: dropped, not forwarded
+        if topic == CTL_TOPIC:  # GRAFT/PRUNE control: per-link, not flooded
+            self.mesh_router.on_control(peer, payload)
+            return
         if self._mark_seen(topic, payload):
             return
         _GOSSIP_RX.inc()
@@ -267,6 +297,11 @@ class NetworkService:
             kind = "attestation"
         if kind is None and "/sync_committee_" in topic:
             kind = "sync_message"
+        if kind is not None:
+            # only RECOGNIZED topics become mesh-managed: junk topics from
+            # a hostile peer must never enter (or propagate through) the
+            # mesh control plane
+            self.mesh_router.track(topic)
         fb = self._feedback(peer)
         try:
             if kind == "block":
@@ -317,8 +352,14 @@ class NetworkService:
         except Exception:
             self.peer_manager.report(peer, "undecodable")
             return
-        # forward to the mesh (flood-publish, minus the sender)
-        self.transport.publish(topic, payload, exclude=peer)
+        # relay to the topic mesh (flood fallback while the mesh is
+        # thinner than D_low), minus the sender
+        members = self.mesh_router.relay_peers(topic, exclude=peer)
+        if members is None:
+            self.transport.publish(topic, payload, exclude=peer)
+        else:
+            for p in members:
+                p.send(KIND_GOSSIP, topic.encode(), payload)
 
     def _after_block(self, result) -> None:
         """Unknown-parent blocks trigger sync; others are done."""

@@ -178,6 +178,7 @@ class Transport:
         self.on_gossip: Callable = lambda *a: None
         self.on_request: Callable = lambda *a: b""
         self.on_peer_connected: Callable = lambda peer: None
+        self.on_peer_removed: Callable = lambda peer: None
         self._server = socket.create_server((host, port))
         self.host = host
         self.port = self._server.getsockname()[1]
@@ -234,6 +235,10 @@ class Transport:
         with self._lock:
             if peer in self.peers:
                 self.peers.remove(peer)
+        try:
+            self.on_peer_removed(peer)
+        except Exception:
+            pass  # a cleanup-hook bug must not break peer teardown
 
     def peer_count(self) -> int:
         with self._lock:

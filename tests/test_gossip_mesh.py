@@ -1,0 +1,95 @@
+"""Gossipsub-style mesh control (reference gossipsub behaviour +
+``gossipsub_scoring_parameters.rs`` degree params): mesh formation on
+real topics, GRAFT refusal for unknown topics, PRUNE + backoff,
+relay-through-mesh delivery, and flood fallback below D_low."""
+
+import time
+
+import pytest
+
+from lighthouse_tpu.crypto import backend
+from lighthouse_tpu.network.mesh import GRAFT, MeshRouter, PRUNE
+from lighthouse_tpu.testing.simulator import LocalNetwork
+
+
+@pytest.fixture(autouse=True)
+def fake_backend():
+    backend.set_backend("fake")
+    yield
+    backend.set_backend("cpu")
+
+
+def _settle_mesh(net, topic, timeout=6.0):
+    """Heartbeat all nodes until every mesh for ``topic`` is >= D_LOW
+    (bidirectional grafting needs a couple of rounds)."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        for n in net.nodes:
+            n.net.mesh_router.track(topic)
+            n.net.mesh_router.heartbeat()
+        time.sleep(0.1)  # control frames propagate via reader threads
+        if all(
+            len([p for p in n.net.mesh_router.mesh.get(topic, ()) if not p.closed])
+            >= MeshRouter.D_LOW
+            for n in net.nodes
+        ):
+            return
+    raise AssertionError("meshes failed to fill")
+
+
+def test_mesh_forms_bidirectionally_on_block_topic():
+    net = LocalNetwork(4, validator_count=8)
+    for _ in range(2):
+        net.tick_slot(attest=True)
+    topic = net.nodes[0].net.topics.block()
+    _settle_mesh(net, topic)
+    # every node ended with a non-trivial mesh — reciprocity happened
+    for n in net.nodes:
+        assert len(n.net.mesh_router.mesh[topic]) >= MeshRouter.D_LOW
+
+
+def test_graft_for_unknown_topic_is_refused():
+    net = LocalNetwork(2, validator_count=8)
+    b = net.nodes[1].net
+    peer_at_b = b.transport.peers[0]
+    b.mesh_router.on_control(peer_at_b, GRAFT + b"/junk/topic")
+    # no mesh state may be created by a remote control frame
+    assert "/junk/topic" not in b.mesh_router.mesh
+
+
+def test_prune_removes_member_and_backs_off():
+    net = LocalNetwork(2, validator_count=8)
+    a = net.nodes[0].net
+    topic = "/test/topic2"
+    a.mesh_router.track(topic)
+    peer = a.transport.peers[0]
+    a.mesh_router.on_control(peer, GRAFT + topic.encode())
+    assert peer in a.mesh_router.mesh[topic]
+    a.mesh_router.on_control(peer, PRUNE + topic.encode())
+    assert peer not in a.mesh_router.mesh[topic]
+    # backoff: the next heartbeat must NOT re-graft the pruning peer
+    a.mesh_router.heartbeat()
+    assert peer not in a.mesh_router.mesh[topic], "prune backoff ignored"
+
+
+def test_relay_through_mesh_reaches_everyone():
+    """With filled meshes, a block published by one node reaches every
+    node (relay goes mesh-only once >= D_LOW members past the sender)."""
+    net = LocalNetwork(4, validator_count=8)
+    for _ in range(2):
+        net.tick_slot(attest=True)
+    topic = net.nodes[0].net.topics.block()
+    _settle_mesh(net, topic)
+    net.tick_slot(attest=True)  # flood at origin + mesh relay
+    net.check_all_heads_equal()
+
+
+def test_flood_fallback_below_dlow():
+    net = LocalNetwork(2, validator_count=8)
+    r = net.nodes[0].net.mesh_router
+    assert r.relay_peers("/never/seen") is None  # empty mesh -> flood
+    # sender does not count toward the threshold
+    r.track("/t")
+    peer = net.nodes[0].net.transport.peers[0]
+    r.mesh["/t"].add(peer)
+    assert r.relay_peers("/t", exclude=peer) is None
