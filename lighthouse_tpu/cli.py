@@ -45,6 +45,10 @@ def build_parser() -> argparse.ArgumentParser:
                     help="quick-start genesis with N deterministic validators")
     bn.add_argument("--genesis-time", type=int, default=None)
     bn.add_argument("--workers", type=int, default=2)
+    bn.add_argument("--listen-port", type=int, default=None,
+                    help="p2p listen port (0 = free port; omit = no p2p)")
+    bn.add_argument("--boot-nodes", nargs="*", default=[],
+                    help="host:port addresses to dial at startup")
 
     vc = sub.add_parser("vc", help="run a validator client")
     _add_global_flags(vc)
@@ -144,6 +148,12 @@ def run_bn(args) -> int:
     from .types.chain_spec import minimal_spec
     from .utils import metrics
 
+    listen_port = args.listen_port
+    if args.boot_nodes and listen_port is None:
+        # boot nodes imply p2p: dialing without a listener would silently
+        # no-op in the builder
+        listen_port = 0
+        print("--boot-nodes given without --listen-port: listening on a free port")
     cfg = ClientConfig(
         preset_base=args.preset,
         datadir=args.datadir,
@@ -151,6 +161,8 @@ def run_bn(args) -> int:
         http_port=args.http_port,
         bls_backend=args.bls_backend,
         n_workers=args.workers,
+        listen_port=listen_port,
+        boot_nodes=tuple(args.boot_nodes),
     )
     spec = minimal_spec() if args.preset == "minimal" else None
     builder = ClientBuilder(cfg, spec)

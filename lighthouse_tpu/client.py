@@ -7,6 +7,7 @@ network/processor -> HTTP API -> timers; plus ``timer`` and
 from __future__ import annotations
 
 import copy
+import json
 import threading
 from dataclasses import dataclass, field
 from typing import Optional
@@ -36,6 +37,10 @@ class ClientConfig:
     # None = off; "auto" = monitor every validator; or a list of indices
     monitor_validators: object = None
     slasher: bool = False  # store-backed min-max-span slashing detection
+    # None = no p2p network (library/tests); 0 = listen on a free port
+    listen_port: object = None
+    listen_host: str = "127.0.0.1"
+    boot_nodes: tuple = ()  # "host:port" strings dialed at startup
 
 
 class Client:
@@ -45,6 +50,7 @@ class Client:
         self.chain = chain
         self.processor = processor
         self.api = api
+        self.network = None  # attached by the builder when listening
         self.slot_clock = slot_clock
         self._timer = timer
         self._stop = threading.Event()
@@ -62,6 +68,8 @@ class Client:
                 self.api.stop()
             self.processor.shutdown()
             self.persist()
+            if self.network is not None:
+                self.network.close()
         finally:
             lock = getattr(self, "_lock", None)
             if lock is not None:
@@ -96,6 +104,15 @@ class Client:
         try:
             if self.chain.slasher is not None:
                 self.chain.slasher.flush()
+        except Exception:
+            pass
+        try:
+            if self.network is not None:
+                store.put_blob(
+                    Column.METADATA,
+                    b"known_peers",
+                    json.dumps(self.network.discovery.addresses()).encode(),
+                )
         except Exception:
             pass
 
@@ -263,6 +280,26 @@ class ClientBuilder:
             store.put_block(_htr(cp_block.message), cp_block)
 
         processor = _build_processor(chain, cfg.n_workers)
+
+        network = None
+        if cfg.listen_port is not None:
+            from .network.service import NetworkService
+
+            network = NetworkService(
+                chain, processor, host=cfg.listen_host, port=int(cfg.listen_port)
+            )
+            known = store.get_blob(Column.METADATA, b"known_peers")
+            if known is not None:
+                try:
+                    network.discovery.import_addresses(json.loads(known))
+                except Exception:
+                    pass
+            for addr in cfg.boot_nodes:
+                try:
+                    host, port = addr.rsplit(":", 1)
+                    network.connect(host, int(port))
+                except (ValueError, OSError):
+                    pass
         api = (
             BeaconApiServer(chain, cfg.http_host, cfg.http_port)
             if cfg.http_enabled
@@ -273,6 +310,7 @@ class ClientBuilder:
             target=_slot_timer, args=(chain, clock, stop), daemon=True
         )
         client = Client(chain, processor, api, clock, timer)
+        client.network = network
         client._stop = stop
         client._lock = lock
         return client

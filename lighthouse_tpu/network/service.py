@@ -104,12 +104,6 @@ class NetworkService:
             for fork in ("phase0", "altair", "bellatrix")
         }
         self.transport = Transport(host, port)
-        self.transport.on_gossip = self._on_gossip
-        self.transport.on_request = self._on_request
-        self.transport.on_peer_connected = self._on_peer_connected
-        self.transport.on_peer_removed = (
-            lambda peer: self.mesh_router.remove_peer(peer)
-        )
         self.peer_manager = PeerManager()
         self.peer_manager.on_disconnect = lambda p: p.close()
         self._seen: dict[bytes, float] = {}  # gossip message-id dedup
@@ -124,6 +118,19 @@ class NetworkService:
         self._mesh_thread.start()
         self.sync = RangeSync(self)
         self.backfill = BackfillSync(self)
+        from .discovery import Discovery
+
+        self.discovery = Discovery(self).start()
+        # callbacks are wired LAST: the accept thread is live from the
+        # Transport constructor, and an early inbound handshake must not
+        # race attributes (sync/discovery/mesh) into AttributeErrors —
+        # until here such peers just get the transport's no-op handlers
+        self.transport.on_gossip = self._on_gossip
+        self.transport.on_request = self._on_request
+        self.transport.on_peer_connected = self._on_peer_connected
+        self.transport.on_peer_removed = (
+            lambda peer: self.mesh_router.remove_peer(peer)
+        )
         # the HTTP API's /node/identity + /node/peers read this
         chain.network = self
 
@@ -143,9 +150,13 @@ class NetworkService:
     def connect(self, host: str, port: int) -> Optional[Peer]:
         if self.peer_manager.is_banned(host):
             return None
-        return self.transport.dial(host, port)
+        peer = self.transport.dial(host, port)
+        if peer is not None:
+            self.discovery.learn(host, port)
+        return peer
 
     def close(self) -> None:
+        self.discovery.stop()
         self._mesh_stop.set()
         self.transport.close()
 
@@ -390,6 +401,7 @@ class NetworkService:
                 pass
         px = peer.request(PROTO_PEER_EXCHANGE.encode(), b"[]")
         if px:
+            self.discovery.learn_from_px(px)
             try:
                 for host, port in json.loads(px):
                     if port != self.port and self.transport.peer_count() < 32:

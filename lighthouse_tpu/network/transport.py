@@ -191,6 +191,8 @@ class Transport:
     # -- peer management -------------------------------------------------
 
     def dial(self, host: str, port: int) -> Optional[Peer]:
+        if not self._running:
+            return None  # a closed transport must not open new sockets
         with self._lock:
             for p in self.peers:
                 if p.remote_listen_port == port and p.addr[0] == host:

@@ -1,0 +1,128 @@
+"""Peer discovery (the discv5-service replacement): random-walk address
+learning over the peer-exchange RPC, target-count maintenance, address
+table bounds/persistence, and the bn client's network wiring."""
+
+import copy
+import time
+
+import pytest
+
+from lighthouse_tpu.client import ClientBuilder, ClientConfig
+from lighthouse_tpu.crypto import backend
+from lighthouse_tpu.network.discovery import Discovery
+from lighthouse_tpu.testing import StateHarness
+from lighthouse_tpu.testing.simulator import LocalNode
+from lighthouse_tpu.types import MINIMAL, minimal_spec
+from lighthouse_tpu.utils.slot_clock import ManualSlotClock
+
+
+@pytest.fixture(autouse=True)
+def fake_backend():
+    backend.set_backend("fake")
+    yield
+    backend.set_backend("cpu")
+
+
+def _mk_nodes(n):
+    h = StateHarness(
+        MINIMAL, minimal_spec(), validator_count=8, fork_name="phase0",
+        fake_sign=True,
+    )
+    genesis = copy.deepcopy(h.state)
+    clock = ManualSlotClock(genesis.genesis_time, h.spec.seconds_per_slot)
+    return [LocalNode(h, genesis, clock) for _ in range(n)]
+
+
+def test_random_walk_reaches_transitive_peers():
+    """Chain topology A-B-C-D: D only knows C, but discovery rounds must
+    eventually connect D to A (multi-hop peer-exchange walk)."""
+    nodes = _mk_nodes(4)
+    try:
+        a, b, c, d = nodes
+        # the handshake's peer exchange would flood-fill the mesh; build
+        # the chain topology and then drive ONLY d's discovery rounds
+        b.net.connect("127.0.0.1", a.net.port)
+        time.sleep(0.2)
+        c.net.connect("127.0.0.1", b.net.port)
+        time.sleep(0.2)
+        d.net.connect("127.0.0.1", c.net.port)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            d.net.discovery.round()
+            known_ports = {p for _, p in d.net.discovery.addresses()}
+            connected = {
+                p.remote_listen_port for p in d.net.transport.peers
+            }
+            if a.net.port in connected:
+                break
+            time.sleep(0.1)
+        assert a.net.port in {
+            p.remote_listen_port for p in d.net.transport.peers
+        }, "random walk never reached the far end of the chain"
+    finally:
+        for n in nodes:
+            n.net.close()
+
+
+def test_table_bounds_and_roundtrip():
+    nodes = _mk_nodes(1)
+    try:
+        disc = nodes[0].net.discovery
+        for i in range(Discovery.MAX_TABLE + 50):
+            disc.learn("10.0.0.1", 1000 + i)
+        assert len(disc.addresses()) <= Discovery.MAX_TABLE
+        exported = disc.addresses()
+        disc2 = Discovery(nodes[0].net)
+        disc2.import_addresses(exported)
+        assert sorted(map(tuple, disc2.addresses())) == sorted(
+            map(tuple, exported)
+        )
+        # own address never enters the table
+        disc.learn("127.0.0.1", nodes[0].net.port)
+        assert ["127.0.0.1", nodes[0].net.port] not in disc.addresses()
+    finally:
+        nodes[0].net.close()
+
+
+def test_bn_client_network_and_bootnode(tmp_path):
+    """Two bn clients with p2p enabled: the second boots from the first
+    and they connect; known peers persist across stop."""
+    h = StateHarness(
+        MINIMAL, minimal_spec(), validator_count=8, fork_name="phase0",
+        fake_sign=True,
+    )
+
+    def build(datadir, boot=()):
+        import os
+
+        os.makedirs(datadir, exist_ok=True)
+        cfg = ClientConfig(
+            preset_base="minimal", datadir=str(datadir), http_enabled=False,
+            bls_backend="fake", listen_port=0, boot_nodes=boot,
+        )
+        b = ClientBuilder(cfg, minimal_spec())
+        b.genesis_state = copy.deepcopy(h.state)
+        return b.build()
+
+    c1 = build(tmp_path / "n1")
+    try:
+        port1 = c1.network.port
+        c2 = build(tmp_path / "n2", boot=(f"127.0.0.1:{port1}",))
+        try:
+            deadline = time.time() + 5
+            while time.time() < deadline and c1.network.transport.peer_count() == 0:
+                time.sleep(0.05)
+            assert c1.network.transport.peer_count() >= 1
+            assert c2.network.transport.peer_count() >= 1
+        finally:
+            c2.stop()
+        # persistence: n2's store remembers n1's address
+        from lighthouse_tpu.store import Column, SqliteStore
+
+        kv = SqliteStore(f"{tmp_path}/n2/chain.sqlite")
+        import json
+
+        known = json.loads(kv.get(Column.METADATA, b"known_peers"))
+        assert ["127.0.0.1", port1] in known
+    finally:
+        c1.stop()
