@@ -49,6 +49,8 @@ def build_parser() -> argparse.ArgumentParser:
                     help="p2p listen port (0 = free port; omit = no p2p)")
     bn.add_argument("--boot-nodes", nargs="*", default=[],
                     help="host:port addresses to dial at startup")
+    bn.add_argument("--monitoring-endpoint", default=None,
+                    help="POST process/beacon health to this URL every minute")
 
     vc = sub.add_parser("vc", help="run a validator client")
     _add_global_flags(vc)
@@ -58,6 +60,8 @@ def build_parser() -> argparse.ArgumentParser:
                     help="EIP-2335 keystore path (repeatable)")
     vc.add_argument("--interop-keys", type=str, default=None,
                     help="range like 0:8 of deterministic interop keys")
+    vc.add_argument("--graffiti-file", default=None,
+                    help="per-validator graffiti mapping, reread each proposal")
 
     am = sub.add_parser("am", help="account manager")
     _add_global_flags(am)
@@ -118,6 +122,12 @@ def build_parser() -> argparse.ArgumentParser:
     tb.add_argument("--blocks", nargs="+", required=True)
     tb.add_argument("--out", required=True)
 
+    rr = lcli_sub.add_parser("state-root", help="hash_tree_root of an SSZ state")
+    rr.add_argument("--preset", choices=["mainnet", "minimal"], default="minimal")
+    rr.add_argument("--state", required=True)
+    br = lcli_sub.add_parser("block-root", help="hash_tree_root of an SSZ signed block")
+    br.add_argument("--preset", choices=["mainnet", "minimal"], default="minimal")
+    br.add_argument("--block", required=True)
     nt = lcli_sub.add_parser(
         "new-testnet", help="write a testnet directory (config + genesis)"
     )
@@ -163,6 +173,7 @@ def run_bn(args) -> int:
         n_workers=args.workers,
         listen_port=listen_port,
         boot_nodes=tuple(args.boot_nodes),
+        monitoring_endpoint=args.monitoring_endpoint,
     )
     spec = minimal_spec() if args.preset == "minimal" else None
     builder = ClientBuilder(cfg, spec)
@@ -214,7 +225,12 @@ def run_vc(args) -> int:
             ks = json.load(f)
         store.add_keystore(ks, getpass.getpass(f"password for {path}: "))
     clock = SystemTimeSlotClock(int(genesis["genesis_time"]), spec.seconds_per_slot)
-    vc = ValidatorClient(store, nodes, t, preset, clock)
+    graffiti_file = None
+    if args.graffiti_file:
+        from .validator_client.graffiti import GraffitiFile
+
+        graffiti_file = GraffitiFile(args.graffiti_file)
+    vc = ValidatorClient(store, nodes, t, preset, clock, graffiti_file=graffiti_file)
     print(f"validator client up: {len(store.pubkeys())} keys", flush=True)
     signal.signal(signal.SIGINT, lambda *a: vc.stop())
     signal.signal(signal.SIGTERM, lambda *a: vc.stop())
@@ -474,6 +490,26 @@ def run_lcli(args) -> int:
             return 1
         obj = tpe.decode(raw)
         print(json.dumps(to_json(tpe, obj), indent=2))
+        return 0
+    if args.lcli_command == "state-root":
+        st = read_state(args.state)
+        from .ssz import hash_tree_root as _htr
+
+        print("0x" + _htr(st).hex())
+        return 0
+    if args.lcli_command == "block-root":
+        import struct as _struct
+
+        from .ssz import hash_tree_root as _htr
+
+        raw = open(args.block, "rb").read()
+        # fork auto-detection from the block slot (same scheme as
+        # transition-blocks): SignedBeaconBlock = offset(4) + sig(96) +
+        # message, whose first field is the u64 slot
+        (slot,) = _struct.unpack_from("<Q", raw, 100)
+        fork = spec.fork_name_at_epoch(slot // preset.SLOTS_PER_EPOCH)
+        sb = t.signed_block[fork].decode(raw)
+        print("0x" + _htr(type(sb.message), sb.message).hex())
         return 0
     if args.lcli_command == "new-testnet":
         import os as _os

@@ -41,6 +41,7 @@ class ClientConfig:
     listen_port: object = None
     listen_host: str = "127.0.0.1"
     boot_nodes: tuple = ()  # "host:port" strings dialed at startup
+    monitoring_endpoint: Optional[str] = None  # remote metrics push URL
 
 
 class Client:
@@ -51,6 +52,7 @@ class Client:
         self.processor = processor
         self.api = api
         self.network = None  # attached by the builder when listening
+        self.monitoring = None  # attached when a monitoring endpoint is set
         self.slot_clock = slot_clock
         self._timer = timer
         self._stop = threading.Event()
@@ -68,6 +70,8 @@ class Client:
                 self.api.stop()
             self.processor.shutdown()
             self.persist()
+            if self.monitoring is not None:
+                self.monitoring.stop()
             if self.network is not None:
                 self.network.close()
         finally:
@@ -311,6 +315,12 @@ class ClientBuilder:
         )
         client = Client(chain, processor, api, clock, timer)
         client.network = network
+        if cfg.monitoring_endpoint:
+            from .utils.monitoring import MonitoringService
+
+            client.monitoring = MonitoringService(
+                chain, cfg.monitoring_endpoint
+            ).start()
         client._stop = stop
         client._lock = lock
         return client

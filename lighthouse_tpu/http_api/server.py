@@ -505,6 +505,7 @@ class BeaconApiServer:
                 chain.process_block(sb)
             except Exception as e:
                 raise ApiError(400, f"block rejected: {e}")
+            _publish(chain, "publish_block", sb)
             return None
 
         if path == "/eth/v1/beacon/pool/attestations":
@@ -518,6 +519,7 @@ class BeaconApiServer:
                     chain.apply_attestation_to_fork_choice(v)
                     if chain.op_pool is not None:
                         chain.op_pool.insert_attestation(att)
+                    _publish(chain, "publish_attestation", att, int(att.data.index))
                 except Exception as e:
                     results.append(str(e))
             if results:
@@ -527,17 +529,20 @@ class BeaconApiServer:
             ex = from_json(t.SignedVoluntaryExit, body)
             if chain.op_pool is not None:
                 chain.op_pool.insert_voluntary_exit(ex)
+            _publish(chain, "publish_voluntary_exit", ex)
             return None
         if path == "/eth/v1/beacon/pool/attester_slashings" and method == "POST":
             s = from_json(t.AttesterSlashing, body)
             if chain.op_pool is not None:
                 chain.op_pool.insert_attester_slashing(s)
             chain.on_attester_slashing(s)
+            _publish(chain, "publish_attester_slashing", s)
             return None
         if path == "/eth/v1/beacon/pool/proposer_slashings" and method == "POST":
             s = from_json(t.ProposerSlashing, body)
             if chain.op_pool is not None:
                 chain.op_pool.insert_proposer_slashing(s)
+            _publish(chain, "publish_proposer_slashing", s)
             return None
 
         if path == "/eth/v1/beacon/pool/sync_committees" and method == "POST":
@@ -779,6 +784,7 @@ class BeaconApiServer:
                 chain.process_block(sb)
             except Exception as e:
                 raise ApiError(400, f"block rejected: {e}")
+            _publish(chain, "publish_block", sb)
             return None
 
         m = re.fullmatch(r"/eth/v1/beacon/rewards/blocks/([^/]+)", path)
@@ -981,6 +987,18 @@ def _best_aggregate(chain, slot: int, data_root: bytes):
             data=data,
             signature=best.signature,
         )
+
+
+def _publish(chain, method: str, *args) -> None:
+    """Gossip an API-submitted object when a network is attached
+    (reference: the publish routes gossip after import)."""
+    net = getattr(chain, "network", None)
+    if net is None:
+        return
+    try:
+        getattr(net, method)(*args)
+    except Exception:
+        pass  # gossip is best-effort; the object is already imported
 
 
 def _blind_block(t, block):

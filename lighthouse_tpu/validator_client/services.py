@@ -338,11 +338,14 @@ class BlockService:
     """Proposal flow: randao -> produce -> sign -> publish (reference
     ``block_service.rs``)."""
 
-    def __init__(self, store, nodes: BeaconNodeFallback, duties: DutiesService, preset):
+    def __init__(self, store, nodes: BeaconNodeFallback, duties: DutiesService, preset,
+                 graffiti_file=None):
         self.store = store
         self.nodes = nodes
         self.duties = duties
         self.preset = preset
+        # reference common/graffiti_file: reread per proposal
+        self.graffiti_file = graffiti_file
 
     def propose(self, slot: int) -> int:
         epoch = slot // self.preset.SLOTS_PER_EPOCH
@@ -352,7 +355,12 @@ class BlockService:
                 continue
             try:
                 randao = self.store.randao_reveal(duty.pubkey, epoch)
-                block = self.nodes.call("produce_block", slot, randao)
+                graffiti = bytes(32)
+                if self.graffiti_file is not None:
+                    graffiti = (
+                        self.graffiti_file.graffiti_for(duty.pubkey) or graffiti
+                    )
+                block = self.nodes.call("produce_block", slot, randao, graffiti)
                 signed = self.store.sign_block(duty.pubkey, block)
                 self.nodes.call("publish_block", signed)
                 published += 1
@@ -405,14 +413,17 @@ class ValidatorClient:
     """Wires the services to a slot clock (reference
     ``validator_client/src/lib.rs``)."""
 
-    def __init__(self, store, nodes: BeaconNodeFallback, types, preset, slot_clock):
+    def __init__(self, store, nodes: BeaconNodeFallback, types, preset, slot_clock,
+                 graffiti_file=None):
         self.store = store
         self.nodes = nodes
         self.preset = preset
         self.slot_clock = slot_clock
         self.duties = DutiesService(store, nodes, preset)
         self.attestations = AttestationService(store, nodes, self.duties, types)
-        self.blocks = BlockService(store, nodes, self.duties, preset)
+        self.blocks = BlockService(
+            store, nodes, self.duties, preset, graffiti_file=graffiti_file
+        )
         self.sync_committee = SyncCommitteeService(store, nodes, preset)
         from .preparation_service import PreparationService
 

@@ -1,0 +1,134 @@
+"""Round-4 operator round-out: graffiti file (reread per proposal),
+monitoring push (reference common/monitoring_api), API-submitted gossip
+publication, and lcli root helpers."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from lighthouse_tpu.crypto import backend
+from lighthouse_tpu.validator_client.graffiti import GraffitiFile
+
+
+@pytest.fixture(autouse=True)
+def fake_backend():
+    backend.set_backend("fake")
+    yield
+    backend.set_backend("cpu")
+
+
+def test_graffiti_file_lookup_and_reread(tmp_path):
+    path = tmp_path / "graffiti.txt"
+    pk = b"\xaa" * 48
+    path.write_text(
+        "# comment\ndefault: hello world\n0x" + pk.hex() + ": mine\n"
+    )
+    g = GraffitiFile(path)
+    assert g.graffiti_for(pk).rstrip(b"\x00") == b"mine"
+    assert g.graffiti_for(b"\xbb" * 48).rstrip(b"\x00") == b"hello world"
+    # reread: edits apply without restart
+    path.write_text("default: changed\n")
+    assert g.graffiti_for(pk).rstrip(b"\x00") == b"changed"
+    # missing file -> None (caller falls back)
+    assert GraffitiFile(tmp_path / "absent").graffiti_for(pk) is None
+
+
+def test_monitoring_push(tmp_path):
+    from lighthouse_tpu.testing.simulator import LocalNetwork
+    from lighthouse_tpu.utils.monitoring import MonitoringService, collect
+
+    received = []
+
+    class H(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            received.append(json.loads(self.rfile.read(n)))
+            self.send_response(200)
+            self.end_headers()
+
+    httpd = HTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    net = LocalNetwork(1, validator_count=8)
+    try:
+        chain = net.nodes[0].chain
+        doc = collect(chain)
+        assert doc["beacon_node"]["head_slot"] == 0
+        assert doc["process"]["pid"] > 0
+        svc = MonitoringService(
+            chain, f"http://127.0.0.1:{httpd.server_address[1]}/push"
+        )
+        assert svc.push_once() is True
+        assert received and received[0]["general"]["version"].startswith(
+            "lighthouse_tpu/"
+        )
+        assert "beacon_node" in received[0]
+    finally:
+        httpd.shutdown()
+        net.nodes[0].net.close()
+
+
+def test_api_post_publishes_over_gossip():
+    """A block POSTed to node A's HTTP API must arrive at node B over
+    gossip (reference: the publish routes gossip after import)."""
+    import time
+    import urllib.request
+
+    from lighthouse_tpu.http_api import BeaconApiServer
+    from lighthouse_tpu.ssz.json import to_json
+    from lighthouse_tpu.testing.simulator import LocalNetwork
+
+    net = LocalNetwork(2, validator_count=8)
+    server = BeaconApiServer(net.nodes[0].chain, port=0).start()
+    try:
+        h = net.h
+        slot = h.state.slot + 1
+        net.clock.set_slot(slot)
+        for n in net.nodes:
+            n.chain.on_tick(slot)
+        sb = h.produce_block(slot)
+        h.process_block(sb, strategy="none")
+        body = json.dumps(
+            {"version": "phase0", "data": to_json(type(sb), sb)}
+        ).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/eth/v1/beacon/blocks",
+            data=body, headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        urllib.request.urlopen(req, timeout=10)
+        deadline = time.time() + 5
+        root = net.nodes[0].chain.head_block_root
+        while time.time() < deadline:
+            net.nodes[1].chain.recompute_head()
+            if net.nodes[1].chain.head_block_root == root:
+                break
+            time.sleep(0.05)
+        assert net.nodes[1].chain.head_block_root == root, "gossip never arrived"
+    finally:
+        server.stop()
+        for n in net.nodes:
+            n.net.close()
+
+
+def test_lcli_roots(tmp_path):
+    from lighthouse_tpu.cli import main
+    from lighthouse_tpu.ssz import hash_tree_root
+    from lighthouse_tpu.state_transition import interop_genesis_state
+    from lighthouse_tpu.types import MINIMAL, minimal_spec
+    from lighthouse_tpu.types.containers import FORK_IDS, types_for
+
+    st = interop_genesis_state(MINIMAL, minimal_spec(), 8)
+    p = tmp_path / "state.ssz"
+    p.write_bytes(bytes([FORK_IDS["phase0"]]) + type(st).encode(st))
+    import io
+    from contextlib import redirect_stdout
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert main(["lcli", "state-root", "--state", str(p)]) == 0
+    assert buf.getvalue().strip() == "0x" + hash_tree_root(st).hex()
