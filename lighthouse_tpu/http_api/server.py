@@ -331,16 +331,70 @@ class BeaconApiServer:
                     "finalized": cp(st.finalized_checkpoint),
                 }
             }
+        m = re.fullmatch(r"/eth/v1/beacon/states/([^/]+)/validator_balances", path)
+        if m:
+            st = self._state_for(m.group(1))
+            ids = _parse_validator_ids(query)
+            out = []
+            for i, bal in enumerate(st.balances):
+                if ids is not None:
+                    pk_hex = "0x" + bytes(st.validators[i].pubkey).hex()
+                    if str(i) not in ids and pk_hex not in ids:
+                        continue
+                out.append({"index": str(i), "balance": str(bal)})
+            return {"data": out}
+
+        m = re.fullmatch(r"/eth/v1/beacon/states/([^/]+)/sync_committees", path)
+        if m:
+            st = self._state_for(m.group(1))
+            if fork_of(st) == "phase0":
+                raise ApiError(400, "state has no sync committees (phase0)")
+            P = chain.preset
+            state_epoch = int(st.slot) // P.SLOTS_PER_EPOCH
+            period = state_epoch // P.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+            committee = st.current_sync_committee
+            if "epoch" in query:
+                want_period = int(query["epoch"]) // P.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+                if want_period == period + 1:
+                    committee = st.next_sync_committee
+                elif want_period != period:
+                    raise ApiError(
+                        400, f"epoch outside the state's sync-committee periods"
+                    )
+            indices = []
+            for pk in committee.pubkeys:
+                idx = chain.pubkey_cache.get_index(bytes(pk))
+                if idx is not None:
+                    indices.append(idx)
+            sub = P.sync_subcommittee_size or 1
+            aggregates = [
+                [str(i) for i in indices[k : k + sub]]
+                for k in range(0, len(indices), sub)
+            ]
+            return {
+                "data": {
+                    "validators": [str(i) for i in indices],
+                    "validator_aggregates": aggregates,
+                }
+            }
+
+        m = re.fullmatch(r"/eth/v1/beacon/pool/(voluntary_exits|attester_slashings|proposer_slashings)", path)
+        if m and method == "GET":
+            pool = chain.op_pool
+            if pool is None:
+                return {"data": []}
+            kind = m.group(1)
+            tpe = {
+                "voluntary_exits": t.SignedVoluntaryExit,
+                "attester_slashings": t.AttesterSlashing,
+                "proposer_slashings": t.ProposerSlashing,
+            }[kind]
+            return {"data": [to_json(tpe, o) for o in pool.contents()[kind]]}
+
         m = re.fullmatch(r"/eth/v1/beacon/states/([^/]+)/validators", path)
         if m:
             st = self._state_for(m.group(1))
-            # spec allows ?id=1,2 and repeated ?id= params
-            ids = {
-                x
-                for chunk in query.get("id", "").split(",")
-                for x in [chunk.strip()]
-                if x
-            } or None
+            ids = _parse_validator_ids(query)
             out = []
             for i, (v, bal) in enumerate(zip(st.validators, st.balances)):
                 pk_hex = "0x" + bytes(v.pubkey).hex()
@@ -987,6 +1041,17 @@ def _best_aggregate(chain, slot: int, data_root: bytes):
             data=data,
             signature=best.signature,
         )
+
+
+def _parse_validator_ids(query) -> set | None:
+    """Spec ValidatorId filter: ?id=1,2 / repeated ?id= / 0x-pubkeys."""
+    ids = {
+        x
+        for chunk in query.get("id", "").split(",")
+        for x in [chunk.strip()]
+        if x
+    }
+    return ids or None
 
 
 def _publish(chain, method: str, *args) -> None:

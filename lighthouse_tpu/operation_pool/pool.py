@@ -330,6 +330,16 @@ class OperationPool:
 
     # -- maintenance -----------------------------------------------------
 
+    def contents(self) -> dict:
+        """Snapshot of the poolable operations (Beacon API pool dumps +
+        persistence consumers) under the pool lock."""
+        with self._lock:
+            return {
+                "voluntary_exits": list(self._voluntary_exits.values()),
+                "attester_slashings": list(self._attester_slashings),
+                "proposer_slashings": list(self._proposer_slashings.values()),
+            }
+
     def prune(self, state) -> None:
         """Drop everything no longer includable (reference prune_all)."""
         P = self.preset

@@ -111,6 +111,30 @@ def test_attestation_rewards_route():
         server.stop()
 
 
+def test_balances_sync_committees_and_pool_dumps():
+    h, chain, clock = _mk_node("altair")
+    server = BeaconApiServer(chain, port=0).start()
+    try:
+        _grow(h, chain, clock, 3)
+        out = _get(server, "/eth/v1/beacon/states/head/validator_balances?id=0,3")
+        assert {r["index"] for r in out["data"]} == {"0", "3"}
+        assert all(int(r["balance"]) > 0 for r in out["data"])
+        sc = _get(server, "/eth/v1/beacon/states/head/sync_committees")["data"]
+        assert len(sc["validators"]) == MINIMAL.SYNC_COMMITTEE_SIZE
+        assert sc["validator_aggregates"]
+        # pool dumps round-trip an inserted exit
+        ex = h.t.SignedVoluntaryExit(
+            message=h.t.VoluntaryExit(epoch=0, validator_index=2),
+            signature=b"\x00" * 96,
+        )
+        chain.op_pool.insert_voluntary_exit(ex)
+        dump = _get(server, "/eth/v1/beacon/pool/voluntary_exits")["data"]
+        assert dump and dump[0]["message"]["validator_index"] == "2"
+        assert _get(server, "/eth/v1/beacon/pool/attester_slashings")["data"] == []
+    finally:
+        server.stop()
+
+
 def test_liveness_and_peer_count_routes():
     h, chain, clock = _mk_node("altair")
     server = BeaconApiServer(chain, port=0).start()
