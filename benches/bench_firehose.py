@@ -1,0 +1,112 @@
+"""BASELINE config #5 — the full-slot firehose, feasibility framing.
+
+A network of N validators produces N/32 single-bit attestations per slot
+(every validator attests once per epoch) plus SYNC_COMMITTEE_SIZE sync
+messages. This bench measures the cpu-native (blst-class C) backend's
+verification throughput on exactly that workload shape and reports how
+many seconds of verification one 12-second mainnet slot costs — the
+real-time ratio that motivates the TPU backend (a ratio > 1 means the
+CPU cannot keep up and the chain falls behind).
+
+Measured on a sample of the slot's sets (per-set cost is constant for
+single-pubkey sets; the sample size and extrapolation are printed).
+The epoch-boundary state-transition cost is taken from the columnar
+epoch bench (amortized per slot) for the combined budget line.
+
+Run: python benches/bench_firehose.py [--validators 1000000] [--sample 4096]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from lighthouse_tpu.crypto import backend as crypto_backend  # noqa: E402
+from lighthouse_tpu.crypto import bls  # noqa: E402
+
+SLOT_SECONDS = 12
+SLOTS_PER_EPOCH = 32
+SYNC_COMMITTEE_SIZE = 512
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--validators", type=int, default=1_000_000)
+    ap.add_argument("--sample", type=int, default=4096)
+    ap.add_argument(
+        "--epoch-columnar-s",
+        type=float,
+        default=4.18,
+        help="1M-validator columnar epoch-processing seconds (bench_epoch.py)",
+    )
+    args = ap.parse_args()
+
+    crypto_backend.set_backend("cpu-native")
+
+    atts_per_slot = args.validators // SLOTS_PER_EPOCH
+    sample = min(args.sample, atts_per_slot)
+    if sample < 1:
+        ap.error("--validators must be >= 32 and --sample >= 1")
+
+    # single-signer attestation sets (the dominant firehose component):
+    # distinct keys, a few distinct messages (committee roots per slot)
+    sks = [bls.SecretKey(50_000 + i) for i in range(sample)]
+    msgs = [bytes([m + 1]) * 32 for m in range(8)]
+    t0 = time.perf_counter()
+    sets = [
+        bls.SignatureSet(
+            sks[i].sign(msgs[i % 8]), [sks[i].public_key()], msgs[i % 8]
+        )
+        for i in range(sample)
+    ]
+    sign_s = time.perf_counter() - t0
+
+    assert bls.verify_signature_sets(sets) is True  # warm
+    t0 = time.perf_counter()
+    assert bls.verify_signature_sets(sets) is True
+    verify_s = time.perf_counter() - t0
+    sets_per_sec = sample / verify_s
+
+    att_slot_cost = atts_per_slot / sets_per_sec
+    # sync messages: single-pubkey fast-aggregate sets, same per-set cost
+    sync_slot_cost = SYNC_COMMITTEE_SIZE / sets_per_sec
+    epoch_per_slot = args.epoch_columnar_s * (args.validators / 1_000_000) / SLOTS_PER_EPOCH
+    total = att_slot_cost + sync_slot_cost + epoch_per_slot
+    ratio = total / SLOT_SECONDS
+
+    print(
+        json.dumps(
+            {
+                "metric": "full_slot_firehose_feasibility",
+                "config": "BASELINE#5",
+                "n_validators": args.validators,
+                "attestations_per_slot": atts_per_slot,
+                "sync_messages_per_slot": SYNC_COMMITTEE_SIZE,
+                "backend": "cpu-native",
+                "measured_sample_sets": sample,
+                "sets_per_sec": round(sets_per_sec, 1),
+                "attestation_verify_s_per_slot": round(att_slot_cost, 1),
+                "sync_verify_s_per_slot": round(sync_slot_cost, 2),
+                "epoch_processing_s_per_slot": round(epoch_per_slot, 3),
+                "total_s_per_slot": round(total, 1),
+                "realtime_ratio": round(ratio, 2),
+                "keeps_up": ratio <= 1.0,
+                "note": (
+                    "ratio > 1 means one CPU core cannot verify a "
+                    f"{args.validators}-validator network's slot load in "
+                    "real time — the workload the TPU backend's "
+                    "150k sets/s/chip target absorbs"
+                ),
+                "setup_sign_s": round(sign_s, 1),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
