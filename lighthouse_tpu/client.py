@@ -438,15 +438,22 @@ def _slot_timer(chain, clock, stop: threading.Event) -> None:
                 # slasher/service/src/service.rs)
                 try:
                     chain.slasher.process_queued()
-                    if chain.op_pool is not None:
-                        while chain.slasher.found_attester_slashings:
-                            chain.op_pool.insert_attester_slashing(
-                                chain.slasher.found_attester_slashings.pop(0)
-                            )
-                        while chain.slasher.found_proposer_slashings:
-                            chain.op_pool.insert_proposer_slashing(
-                                chain.slasher.found_proposer_slashings.pop(0)
-                            )
+                    net = getattr(chain, "network", None)
+                    while chain.slasher.found_attester_slashings:
+                        s = chain.slasher.found_attester_slashings.pop(0)
+                        if chain.op_pool is not None:
+                            chain.op_pool.insert_attester_slashing(s)
+                        # equivocators lose fork-choice weight immediately,
+                        # same as evidence submitted via the API pool route
+                        chain.on_attester_slashing(s)
+                        if net is not None:
+                            net.publish_attester_slashing(s)
+                    while chain.slasher.found_proposer_slashings:
+                        s = chain.slasher.found_proposer_slashings.pop(0)
+                        if chain.op_pool is not None:
+                            chain.op_pool.insert_proposer_slashing(s)
+                        if net is not None:
+                            net.publish_proposer_slashing(s)
                     fin = chain.fork_choice.store.finalized_checkpoint[0]
                     if fin > last_pruned_epoch[0]:
                         chain.slasher.prune(fin)

@@ -306,6 +306,32 @@ class BeaconApiServer:
             }
         if path == "/eth/v1/config/spec":
             return {"data": chain.spec.to_api_dict(chain.preset)}
+        if path == "/eth/v1/config/deposit_contract":
+            return {
+                "data": {
+                    "chain_id": str(chain.spec.deposit_chain_id),
+                    "address": "0x"
+                    + bytes(chain.spec.deposit_contract_address).hex(),
+                }
+            }
+        if path == "/eth/v1/config/fork_schedule":
+            spec = chain.spec
+            entries = []
+            prev_version = spec.genesis_fork_version
+            for fork in ("phase0", "altair", "bellatrix"):
+                epoch = spec.fork_epoch_for(fork)
+                if epoch is None:
+                    continue
+                version = spec.fork_version_for(fork)
+                entries.append(
+                    {
+                        "previous_version": "0x" + bytes(prev_version).hex(),
+                        "current_version": "0x" + bytes(version).hex(),
+                        "epoch": str(epoch),
+                    }
+                )
+                prev_version = version
+            return {"data": entries}
         if path == "/metrics":
             return metrics.gather()
 

@@ -111,6 +111,24 @@ def test_attestation_rewards_route():
         server.stop()
 
 
+def test_config_routes():
+    h, chain, clock = _mk_node("altair")
+    server = BeaconApiServer(chain, port=0).start()
+    try:
+        dc = _get(server, "/eth/v1/config/deposit_contract")["data"]
+        assert dc["address"].startswith("0x") and len(dc["address"]) == 42
+        assert dc["chain_id"].isdigit()
+        fs = _get(server, "/eth/v1/config/fork_schedule")["data"]
+        assert fs[0]["epoch"] == "0"
+        # altair active at 0 in this spec: two entries (phase0 + altair)
+        assert len(fs) >= 2
+        # versions chain: each previous_version == prior current_version
+        for a, b in zip(fs, fs[1:]):
+            assert b["previous_version"] == a["current_version"]
+    finally:
+        server.stop()
+
+
 def test_balances_sync_committees_and_pool_dumps():
     h, chain, clock = _mk_node("altair")
     server = BeaconApiServer(chain, port=0).start()
