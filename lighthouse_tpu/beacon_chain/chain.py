@@ -640,6 +640,16 @@ class BeaconChain:
             target=t.Checkpoint(epoch=epoch, root=target_root),
         )
 
+    def fork_choice_bytes(self) -> bytes:
+        """Serialize fork choice under the chain lock — concurrent
+        on_block/on_attestation mutation otherwise tears the snapshot
+        (found by tests/test_concurrency_stress.py: 'dictionary changed
+        size during iteration')."""
+        from ..fork_choice.persistence import fork_choice_to_bytes
+
+        with self._chain_lock:
+            return fork_choice_to_bytes(self.fork_choice)
+
     def advance_head_state_to(self, slot: int) -> bool:
         """State-advance timer body (reference
         ``state_advance_timer.rs:93-231``): near the end of a slot,

@@ -83,7 +83,6 @@ class Client:
         """Write fork choice + op pool + slasher state to the store
         (reference shutdown persistence: ``beacon_chain.rs:400-440``,
         ``operation_pool/src/persistence.rs``)."""
-        from .fork_choice.persistence import fork_choice_to_bytes
         from .operation_pool.persistence import pool_to_bytes
         from .store.kv import Column
 
@@ -94,7 +93,7 @@ class Client:
             store.put_blob(
                 Column.FORK_CHOICE,
                 b"fork_choice",
-                fork_choice_to_bytes(self.chain.fork_choice),
+                self.chain.fork_choice_bytes(),  # chain-locked snapshot
             )
         except Exception:
             pass

@@ -38,6 +38,9 @@ def _uncp(v) -> tuple[int, bytes]:
 
 
 def fork_choice_to_bytes(fc: ForkChoice) -> bytes:
+    """Caller must own ``fc`` exclusively (the chain serializes via
+    ``BeaconChain.fork_choice_bytes`` under the chain lock) — concurrent
+    mutation tears the nodes/votes iteration."""
     st = fc.store
     doc = {
         "version": _VERSION,
