@@ -516,6 +516,19 @@ class BeaconChain:
             state = self.store.get_state(bytes(block.message.state_root))
             if state is not None:
                 self.store.migrate(bytes(block.message.state_root), state)
+        # Persist fork choice now, not only at shutdown: the store's HEAD
+        # advances on every recompute_head, so a crash between shutdowns
+        # would otherwise restore an old DAG that lacks the persisted head
+        # and stall on ParentUnknown (reference persists on finalization
+        # too, ``beacon_chain.rs:400-440``). Already under the chain RLock.
+        try:
+            from ..store.kv import Column
+
+            self.store.put_blob(
+                Column.FORK_CHOICE, b"fork_choice", self.fork_choice_bytes()
+            )
+        except Exception:
+            pass  # persistence must never break finalization handling
 
     # -- production --------------------------------------------------------
 

@@ -240,6 +240,12 @@ class ClientBuilder:
                 chain.fork_choice = fork_choice_from_bytes(
                     self.preset, self.spec, fc_blob
                 )
+                # The store's HEAD advances on every recompute_head but the
+                # blob is written only on finalization/shutdown: after a
+                # crash the restored DAG may predate the persisted head, and
+                # new blocks building on it would stall as ParentUnknown.
+                # Replay the store blocks between the DAG tip and HEAD.
+                _replay_fork_choice_gap(chain, store)
             except Exception:
                 pass  # corrupt/old blob: fall back to the anchor-built one
 
@@ -323,6 +329,34 @@ class ClientBuilder:
         client._stop = stop
         client._lock = lock
         return client
+
+
+def _replay_fork_choice_gap(chain, store) -> None:
+    """Walk back from the store's persisted HEAD to the first block the
+    restored fork choice knows, then replay the gap (oldest first) into
+    it so the resumed node can extend its own pre-crash head."""
+    head_root = store.get_head()
+    proto = chain.fork_choice.proto
+    if head_root is None or proto.contains(head_root):
+        return
+    gap = []
+    root = head_root
+    while root is not None and not proto.contains(root):
+        block = store.get_block(root)
+        if block is None:
+            return  # chain of unknown ancestry: keep the blob's DAG as-is
+        gap.append((root, block))
+        parent = bytes(block.message.parent_root)
+        root = parent if any(parent) else None
+    if root is None:
+        return  # walked past genesis without meeting the DAG
+    for blk_root, block in reversed(gap):
+        state = store.get_state(bytes(block.message.state_root))
+        if state is None:
+            return
+        chain.fork_choice.on_block(
+            int(block.message.slot), block.message, blk_root, state
+        )
 
 
 def _build_processor(chain, n_workers: int) -> BeaconProcessor:

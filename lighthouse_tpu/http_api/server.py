@@ -84,6 +84,8 @@ class BeaconApiServer:
 
         self._payload_cache: dict = _OD()
         self._payload_cache_cap = 8
+        # handlers run on ThreadingHTTPServer threads: insert/evict/pop race
+        self._payload_cache_lock = threading.Lock()
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -816,11 +818,12 @@ class BeaconApiServer:
                 return {"version": fork, "data": to_json(type(block), block)}
             blinded, payload = _blind_block(t, block)
             header = blinded.body.execution_payload_header
-            self._payload_cache[
-                hash_tree_root(t.ExecutionPayloadHeader, header)
-            ] = payload
-            while len(self._payload_cache) > self._payload_cache_cap:
-                self._payload_cache.popitem(last=False)
+            with self._payload_cache_lock:
+                self._payload_cache[
+                    hash_tree_root(t.ExecutionPayloadHeader, header)
+                ] = payload
+                while len(self._payload_cache) > self._payload_cache_cap:
+                    self._payload_cache.popitem(last=False)
             return {
                 "version": fork,
                 "data": to_json(t.BlindedBeaconBlockBellatrix, blinded),
@@ -836,9 +839,10 @@ class BeaconApiServer:
             else:
                 sbb = from_json(t.SignedBlindedBeaconBlockBellatrix, payload_json)
                 header = sbb.message.body.execution_payload_header
-                payload = self._payload_cache.pop(
-                    hash_tree_root(t.ExecutionPayloadHeader, header), None
-                )
+                with self._payload_cache_lock:
+                    payload = self._payload_cache.pop(
+                        hash_tree_root(t.ExecutionPayloadHeader, header), None
+                    )
                 if payload is None:
                     raise ApiError(400, "unknown payload header (not produced here)")
                 bb = sbb.message
@@ -1212,9 +1216,16 @@ def _attestation_rewards(chain, t, epoch: int, indices) -> dict:
     if cur > epoch + 1:
         raise ApiError(501, "historical attestation rewards not supported")
     comp = altair_reward_components(chain.preset, chain.spec, state)
-    want = [int(i) for i in indices] if indices else [
-        i for i in range(len(state.validators)) if comp["eligible"][i]
-    ]
+    if indices:
+        want = [int(i) for i in indices]
+        n = len(state.validators)
+        for i in want:
+            if not 0 <= i < n:
+                raise ApiError(400, f"validator index {i} out of range")
+    else:
+        want = [
+            i for i in range(len(state.validators)) if comp["eligible"][i]
+        ]
     total = [
         {
             "validator_index": str(i),

@@ -95,13 +95,21 @@ class Discovery:
             return 0
         from .service import PROTO_PEER_EXCHANGE
 
-        with transport._lock:
-            peers = list(transport.peers)
+        peers = transport.peers_snapshot()
         if peers:
+            # the PX walk runs off the round's critical path: a slow peer
+            # (timeout 2s) must not delay the maintenance dials below —
+            # its addresses simply feed the NEXT round
             target = random.choice(peers)
-            raw = target.request(PROTO_PEER_EXCHANGE.encode(), b"[]", timeout=5)
-            if raw:
-                self.learn_from_px(raw)
+
+            def _walk():
+                raw = target.request(
+                    PROTO_PEER_EXCHANGE.encode(), b"[]", timeout=2
+                )
+                if raw:
+                    self.learn_from_px(raw)
+
+            threading.Thread(target=_walk, daemon=True).start()
         connected = {
             (p.addr[0], p.remote_listen_port)
             for p in peers

@@ -214,11 +214,13 @@ class NetworkService:
         )
 
     def _publish(self, topic: str, payload: bytes) -> None:
-        self._mark_seen(topic, payload)
+        mid = self._msg_id(topic, payload)
+        self._mark_seen(topic, payload, mid)
         _GOSSIP_TX.inc()
         # originated messages flood-publish (reference flood_publish for
         # latency-critical topics); the mesh bounds RELAY fan-out only
         self.mesh_router.track(topic)
+        self.mesh_router.remember(topic, mid, payload)
         self.transport.publish(topic, payload)
 
     # -- gossip in -------------------------------------------------------
@@ -228,9 +230,12 @@ class NetworkService:
 
         return hash_bytes(topic.encode() + payload)[:20]
 
-    def _mark_seen(self, topic: str, payload: bytes) -> bool:
-        """True if already seen. Prunes entries older than 10 minutes."""
-        mid = self._msg_id(topic, payload)
+    def _mark_seen(self, topic: str, payload: bytes, mid: bytes | None = None) -> bool:
+        """True if already seen. Prunes entries older than 10 minutes.
+        ``mid`` lets hot paths reuse an already-computed message id (the
+        sha256 runs over the full payload — blocks are large)."""
+        if mid is None:
+            mid = self._msg_id(topic, payload)
         now = time.monotonic()
         with self._seen_lock:
             if mid in self._seen:
@@ -242,6 +247,11 @@ class NetworkService:
                     k: ts for k, ts in self._seen.items() if ts > cutoff
                 }
             return False
+
+    def has_seen(self, msg_id: bytes) -> bool:
+        """IHAVE digest check (mesh router): seen-cache membership by id."""
+        with self._seen_lock:
+            return msg_id in self._seen
 
     # Verification-failure kinds that are NOT the sender's fault (clock
     # skew, duplicates seen first from another peer, not-yet-synced heads)
@@ -290,7 +300,8 @@ class NetworkService:
         if topic == CTL_TOPIC:  # GRAFT/PRUNE control: per-link, not flooded
             self.mesh_router.on_control(peer, payload)
             return
-        if self._mark_seen(topic, payload):
+        mid = self._msg_id(topic, payload)
+        if self._mark_seen(topic, payload, mid):
             return
         _GOSSIP_RX.inc()
         t = self.chain.types
@@ -364,7 +375,9 @@ class NetworkService:
             self.peer_manager.report(peer, "undecodable")
             return
         # relay to the topic mesh (flood fallback while the mesh is
-        # thinner than D_low), minus the sender
+        # thinner than D_low), minus the sender; remember the message so
+        # heartbeat IHAVE digests let non-mesh peers pull it
+        self.mesh_router.remember(topic, mid, payload)
         members = self.mesh_router.relay_peers(topic, exclude=peer)
         if members is None:
             self.transport.publish(topic, payload, exclude=peer)

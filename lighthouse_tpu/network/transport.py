@@ -246,6 +246,12 @@ class Transport:
         with self._lock:
             return len(self.peers)
 
+    def peers_snapshot(self) -> list:
+        """Consistent copy of the peer list for out-of-loop consumers
+        (discovery walk, metrics) — no reaching into ``_lock``."""
+        with self._lock:
+            return list(self.peers)
+
     # -- dispatch --------------------------------------------------------
 
     def _dispatch(self, peer: Peer, kind: int, name: bytes, payload: bytes, req_id: int) -> None:

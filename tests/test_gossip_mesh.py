@@ -84,6 +84,72 @@ def test_relay_through_mesh_reaches_everyone():
     net.check_all_heads_equal()
 
 
+def test_ihave_iwant_repairs_missed_gossip():
+    """A peer outside every mesh (e.g. all its GRAFTs refused) must still
+    obtain relayed messages via the heartbeat IHAVE digest + IWANT pull
+    (advisor r4: relay-only delivery starves non-mesh peers)."""
+    net = LocalNetwork(2, validator_count=8)
+    a, b = net.nodes[0].net, net.nodes[1].net
+    topic = "/test/repair"
+    payload = b"\x01" * 40
+    mid = a._msg_id(topic, payload)
+
+    # a relayed/cached message that B never received
+    a.mesh_router.track(topic)
+    a.mesh_router.remember(topic, mid, payload)
+    assert not b.has_seen(mid)
+
+    # A's heartbeat advertises to non-mesh peers; B pulls via IWANT and
+    # receives the full frame, marking it seen
+    deadline = time.time() + 5.0
+    while time.time() < deadline and not b.has_seen(mid):
+        a.mesh_router.heartbeat()
+        time.sleep(0.1)
+    assert b.has_seen(mid), "IHAVE/IWANT pull failed to deliver"
+
+
+def test_iwant_serves_only_cached_ids():
+    net = LocalNetwork(2, validator_count=8)
+    a = net.nodes[0].net
+
+    class RecordingPeer:
+        def __init__(self):
+            self.sent = []
+            self.closed = False
+
+        def send(self, kind, name, payload, req_id=0):
+            self.sent.append((kind, name, payload))
+            return True
+
+    peer = RecordingPeer()
+    from lighthouse_tpu.network.mesh import IWANT
+
+    # unknown ids must produce NO frames; a cached id exactly one
+    a.mesh_router.on_control(peer, IWANT + b"\x00" * 20)
+    assert peer.sent == []
+    mid = a._msg_id("/t/x", b"payload")
+    a.mesh_router.remember("/t/x", mid, b"payload")
+    a.mesh_router.on_control(peer, IWANT + b"\x00" * 20 + mid)
+    assert [(n, p) for _, n, p in peer.sent] == [(b"/t/x", b"payload")]
+
+
+def test_remember_refuses_oversized_topics_and_bounds_bytes():
+    """A >255-byte topic must not poison heartbeat digests (1-byte topic
+    length on the wire), and the mcache byte budget must hold."""
+    net = LocalNetwork(2, validator_count=8)
+    r = net.nodes[0].net.mesh_router
+    long_topic = "/t/" + "x" * 300
+    r.remember(long_topic, b"\x01" * 20, b"p")
+    assert long_topic not in r._recent
+    r.heartbeat()  # must not raise
+
+    big = b"\x00" * (1 << 20)
+    for i in range(12):  # 12 MiB > MCACHE_MAX_BYTES (8 MiB)
+        r.remember("/t/big", bytes([i]) * 20, big)
+    assert r._mcache_bytes <= r.MCACHE_MAX_BYTES
+    assert len(r._mcache) <= 8
+
+
 def test_flood_fallback_below_dlow():
     net = LocalNetwork(2, validator_count=8)
     r = net.nodes[0].net.mesh_router

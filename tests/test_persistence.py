@@ -115,6 +115,56 @@ def test_kill_and_restart_preserves_state(tmp_path):
         client2.stop()
 
 
+def test_stale_fork_choice_blob_replays_to_head(tmp_path):
+    """Crash recovery: the store's HEAD advances every recompute but the
+    fork-choice blob may be older (advisor r4 medium). On restore the gap
+    blocks must be replayed into the restored DAG, or new blocks building
+    on HEAD stall as ParentUnknown."""
+    h = StateHarness(
+        MINIMAL, minimal_spec(), validator_count=8, fork_name="phase0",
+        fake_sign=True,
+    )
+    client = _build(tmp_path, genesis=copy.deepcopy(h.state))
+    chain = client.chain
+    try:
+        sb = h.produce_block(h.state.slot + 1)
+        h.process_block(sb, strategy="none")
+        chain.process_block(chain.verify_block_for_gossip(sb))
+        stale_blob = chain.fork_choice_bytes()  # snapshot BEFORE the tip
+
+        for _ in range(2):
+            sb = h.produce_block(h.state.slot + 1)
+            h.process_block(sb, strategy="none")
+            chain.process_block(chain.verify_block_for_gossip(sb))
+        head_before = chain.fork_choice.get_head()
+    finally:
+        client.stop()
+
+    # simulate the crash: shutdown persisted a fresh blob; rewind it
+    from lighthouse_tpu.store import SqliteStore
+    from lighthouse_tpu.store.kv import Column
+
+    kv = SqliteStore(f"{tmp_path}/chain.sqlite")
+    kv.put(Column.FORK_CHOICE, b"fork_choice", stale_blob)
+    kv.close()
+
+    client2 = _build(tmp_path)
+    try:
+        proto = client2.chain.fork_choice.proto
+        assert proto.contains(head_before), "gap blocks not replayed"
+        assert client2.chain.fork_choice.get_head() == head_before
+
+        # and the node can extend its pre-crash head
+        sb = h.produce_block(h.state.slot + 1)
+        h.process_block(sb, strategy="none")
+        root = client2.chain.process_block(
+            client2.chain.verify_block_for_gossip(sb)
+        )
+        assert proto.contains(root)
+    finally:
+        client2.stop()
+
+
 def test_restart_without_prior_state_is_clean(tmp_path):
     """A fresh datadir must behave exactly as before the change."""
     h = StateHarness(

@@ -1,0 +1,108 @@
+"""Sub-component compile profile: where do final_exp_is_one's 25.8k and
+map_to_g2's 33.9k HLO lines live? Run ALONE (one XLA process at a time).
+
+Usage: python tools/profile_compile2.py [B]
+"""
+
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+
+from lighthouse_tpu.crypto.device import bls as dbls
+from lighthouse_tpu.crypto.device import curve, fp, fp2, htc, pairing, tower
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 16
+
+
+def clock(name, fn, *args):
+    t0 = time.perf_counter()
+    lowered = jax.jit(fn).lower(*args)
+    t1 = time.perf_counter()
+    try:
+        n_lines = len(lowered.as_text().splitlines())
+    except Exception:
+        n_lines = -1
+    lowered.compile()
+    t2 = time.perf_counter()
+    print(
+        f"{name:28s} lower {t1-t0:7.2f}s  compile {t2-t1:7.2f}s  "
+        f"hlo_lines {n_lines}",
+        flush=True,
+    )
+
+
+f12 = jnp.zeros((B, 2, 3, 2, fp.NL), jnp.int32)
+f2 = jnp.zeros((B, 2, fp.NL), jnp.int32)
+g2pt = (f2, f2, f2)
+
+clock("tower.mul", tower.mul, f12, f12)
+clock("tower.sq", tower.sq, f12)
+clock("tower.inv", tower.inv, f12)
+clock("tower.frobenius", tower.frobenius, f12)
+clock("easy_part", pairing._easy_part, f12)
+
+
+def table_build(t):
+    bases = [t]
+    for _ in range(3):
+        bases.append(tower.frobenius(bases[-1]))
+    bases = [
+        tower.conjugate(b) if lam < 0 else b
+        for b, lam in zip(bases, pairing._LAM)
+    ]
+    one = jnp.broadcast_to(tower.ones(), t.shape).astype(jnp.int32)
+    T = {0: one, 1: bases[0], 2: bases[1], 4: bases[2], 8: bases[3]}
+    for level_sets in (
+        [(3, 1, 2), (5, 1, 4), (9, 1, 8), (6, 2, 4), (10, 2, 8), (12, 4, 8)],
+        [(7, 3, 4), (11, 3, 8), (13, 5, 8), (14, 6, 8)],
+        [(15, 7, 8)],
+    ):
+        lo = jnp.stack([T[a] for _, a, _ in level_sets])
+        hi = jnp.stack([T[b] for _, _, b in level_sets])
+        prod = tower.mul(lo, hi)
+        for j, (s, _, _) in enumerate(level_sets):
+            T[s] = prod[j]
+    return jnp.stack([T[s] for s in range(16)])
+
+
+clock("fexp_table_build", table_build, f12)
+
+
+def multiexp_scan(table):
+    from jax import lax
+
+    idx = jnp.asarray(pairing._MULTIEXP_IDX)
+    acc0 = jnp.take(table, idx[0], axis=0)
+
+    def body(acc, i):
+        acc = tower.sq(acc)
+        acc = tower.mul(acc, jnp.take(table, i, axis=0))
+        return acc, None
+
+    acc, _ = lax.scan(body, acc0, idx[1:])
+    return tower.is_one(acc)
+
+
+clock("fexp_scan", multiexp_scan, jnp.zeros((16, B, 2, 3, 2, fp.NL), jnp.int32))
+clock("tower.is_one", tower.is_one, f12)
+
+# map_to_g2 pieces
+u = jnp.zeros((B, 2, 2, fp.NL), jnp.int32)
+clock("sswu", htc.map_to_curve_sswu, u)
+clock("iso3_map", htc.iso3_map, f2, f2)
+clock("clear_cofactor", htc.clear_cofactor, g2pt)
+clock("fp2.inv", fp2.inv, f2)
+clock("curve.add_g2", lambda p: curve.add(fp2, p, p), g2pt)
+clock("curve.to_affine_g2", lambda p: curve.to_affine(fp2, p), g2pt)
+clock("fp2.mul", fp2.mul, f2, f2)
+clock("fp2.sq", fp2.sq, f2)
+clock("fp.canonical", fp.canonical, f2[:, 0])
