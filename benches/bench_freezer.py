@@ -1,0 +1,118 @@
+"""Freezer layout size at scale (VERDICT r4 item #5 'Done' criterion):
+on-disk bytes for restore points at 100k validators across 4 epochs,
+chunked (store/freezer.py) vs legacy full SSZ snapshots.
+
+The chain itself is synthesized (full 100k-validator epoch transitions in
+the host oracle would take minutes and change nothing about layout
+size): per restore point the slot advances one epoch, every balance
+drifts (rewards), and a handful of validator records change
+(activations/eff-balance steps) — the update pattern the interning is
+designed around. Prints one JSON line for PARITY.md.
+
+Usage: python benches/bench_freezer.py [n_validators] [n_restore_points]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from lighthouse_tpu.ssz import hash_tree_root
+from lighthouse_tpu.store import Column, MemoryStore
+from lighthouse_tpu.store import freezer
+from lighthouse_tpu.types.containers import types_for
+from lighthouse_tpu.types.preset import MAINNET
+
+
+def main() -> None:
+    n_val = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    n_rp = int(sys.argv[2]) if len(sys.argv) > 2 else 4
+
+    t = types_for(MAINNET)
+    P = MAINNET
+    state = t.state["phase0"]()
+    state.genesis_time = 0
+    state.validators = [
+        t.Validator(
+            pubkey=i.to_bytes(48, "big"),
+            withdrawal_credentials=i.to_bytes(32, "big"),
+            effective_balance=32_000_000_000,
+            exit_epoch=2**64 - 1,
+            withdrawable_epoch=2**64 - 1,
+        )
+        for i in range(n_val)
+    ]
+    state.balances = [32_000_000_000 + i % 7 for i in range(n_val)]
+    state.randao_mixes = [bytes([i % 256]) * 32 for i in range(P.EPOCHS_PER_HISTORICAL_VECTOR)]
+
+    kv = MemoryStore()
+    spe = P.SLOTS_PER_EPOCH
+    # per-slot cold index the chunked layout reconstructs vectors from
+    # (normally written by migrate's walk)
+    def _fake_root(tag: int, s: int) -> bytes:
+        return tag.to_bytes(1, "big") + s.to_bytes(31, "big")
+
+    chunked_bytes = 0
+    full_bytes = 0
+    t0 = time.perf_counter()
+    for rp in range(n_rp):
+        slot = (rp + 1) * spe
+        state.slot = slot
+        W = P.SLOTS_PER_HISTORICAL_ROOT
+        for s in range(max(0, slot - W), slot):
+            kv.put(Column.COLD_BLOCK_ROOTS, s.to_bytes(8, "little"), _fake_root(1, s))
+            kv.put(Column.COLD_STATE_ROOTS, s.to_bytes(8, "little"), _fake_root(2, s))
+        block_roots = list(state.block_roots)
+        state_roots = list(state.state_roots)
+        for s in range(max(0, slot - W), slot):
+            block_roots[s % W] = _fake_root(1, s)
+            state_roots[s % W] = _fake_root(2, s)
+        state.block_roots = block_roots
+        state.state_roots = state_roots
+        # epoch churn: every balance drifts, ~64 validator records change
+        state.balances = [b + 12_345 + rp for b in state.balances]
+        for i in range(rp * 64, (rp + 1) * 64):
+            state.validators[i] = t.Validator(
+                pubkey=state.validators[i].pubkey,
+                withdrawal_credentials=state.validators[i].withdrawal_credentials,
+                effective_balance=31_000_000_000,
+                activation_epoch=rp,
+                exit_epoch=2**64 - 1,
+                withdrawable_epoch=2**64 - 1,
+            )
+        root = hash_tree_root(t.Checkpoint(epoch=rp, root=b"\x01" * 32))  # cheap unique key
+        freezer.put_restore_point(kv, t, root, state)
+        chunked_bytes += len(kv.get(Column.COLD_PARTIAL, root))
+        full_bytes += len(type(state).encode(state)) + 1
+
+    # shared tables amortize across restore points: count them once
+    table_bytes = sum(
+        len(kv.get(col, k))
+        for col in (Column.COLD_VREC, Column.COLD_VREC_INDEX, Column.COLD_RANDAO)
+        for k in kv.keys(col)
+    )
+    elapsed = time.perf_counter() - t0
+    print(
+        json.dumps(
+            {
+                "metric": "freezer_restore_point_bytes",
+                "n_validators": n_val,
+                "n_restore_points": n_rp,
+                "full_ssz_bytes": full_bytes,
+                "chunked_bytes": chunked_bytes,
+                "shared_table_bytes": table_bytes,
+                "reduction": round(
+                    full_bytes / (chunked_bytes + table_bytes), 2
+                ),
+                "elapsed_s": round(elapsed, 1),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -10,7 +10,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
-from ..utils import metrics
+from ..utils import logging, metrics
 
 _QUEUE_LEN = metrics.gauge("beacon_processor_queue_total", "queued work items")
 _BATCH_SIZE = metrics.histogram(
@@ -24,6 +24,7 @@ _WAIT_TIME = metrics.histogram(
 _DROPPED = metrics.counter(
     "beacon_processor_dropped_total", "work items shed on full queues"
 )
+_SHED_LATCH = logging.TimeLatch(10.0)
 
 
 class WorkKind(enum.Enum):
@@ -111,6 +112,10 @@ class BeaconProcessor:
             q = self._queues[work.kind]
             if len(q) >= self.queue_bounds[work.kind]:
                 _DROPPED.inc()
+                logging.rate_limited(
+                    _SHED_LATCH, "warn", "work queue full, shedding",
+                    kind=work.kind.name,
+                )
                 return False
             q.append(work)
             _QUEUE_LEN.set(sum(len(q) for q in self._queues.values()))

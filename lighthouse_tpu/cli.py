@@ -403,7 +403,7 @@ def run_boot_node(args) -> int:
         if protocol == PROTO_PEER_EXCHANGE:
             peers = [
                 [p.addr[0], p.remote_listen_port]
-                for p in t.peers
+                for p in t.peers_snapshot()
                 if p.remote_listen_port
             ]
             return _json.dumps(peers).encode()

@@ -237,17 +237,30 @@ class ClientBuilder:
             from .fork_choice.persistence import fork_choice_from_bytes
 
             try:
-                chain.fork_choice = fork_choice_from_bytes(
+                restored = fork_choice_from_bytes(
                     self.preset, self.spec, fc_blob
                 )
+            except Exception:
+                restored = None  # corrupt/old blob: keep the anchor-built one
+            if restored is not None:
+                chain.fork_choice = restored
                 # The store's HEAD advances on every recompute_head but the
                 # blob is written only on finalization/shutdown: after a
                 # crash the restored DAG may predate the persisted head, and
                 # new blocks building on it would stall as ParentUnknown.
                 # Replay the store blocks between the DAG tip and HEAD.
-                _replay_fork_choice_gap(chain, store)
-            except Exception:
-                pass  # corrupt/old blob: fall back to the anchor-built one
+                # Replay failures get their OWN handler: the blob is already
+                # installed, so a swallowed error here would silently keep a
+                # partially-replayed DAG — log it instead.
+                try:
+                    _replay_fork_choice_gap(chain, store)
+                except Exception as e:
+                    from .utils import logging as tlog
+
+                    tlog.log(
+                        "warn", "fork-choice crash-gap replay failed",
+                        error=repr(e)[:120],
+                    )
 
         pool_blob = store.get_blob(Column.OP_POOL, b"pool")
         if pool_blob is not None:

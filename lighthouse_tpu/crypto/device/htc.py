@@ -99,8 +99,11 @@ def sqrt(x):
 # Simplified SWU on E2' (batched, branch-free)
 # ---------------------------------------------------------------------------
 
-def map_to_curve_sswu(u):
-    """u: fp2 [..., 2, NL] -> affine (x, y) on the iso-curve E2'."""
+def sswu_pre(u):
+    """Pre-sqrt half of simplified SWU: u -> (x1, x2, g) where
+    ``g = [gx1, gx2]`` stacked on axis -3 awaits ONE sqrt ladder. Split
+    out so the caller can merge the sqrt with other square roots in the
+    same program (one shared ladder — compile-size lever)."""
     shape = u.shape[:-2]
     Z = jnp.broadcast_to(_dc(_Z2), u.shape).astype(jnp.int32)
     A = jnp.broadcast_to(_dc(_A2), u.shape).astype(jnp.int32)
@@ -123,10 +126,12 @@ def map_to_curve_sswu(u):
     # gx2 = (Z u^2)^3 * gx1 (standard SSWU identity)
     zu2_3 = fp2.mul(fp2.sq(zu2), zu2)
     gx2 = fp2.mul(zu2_3, gx1)
+    return x1, x2, jnp.stack([gx1, gx2], axis=-3)
 
-    # One shared sqrt ladder for both candidates: stack on a new axis.
-    g = jnp.stack([gx1, gx2], axis=-3)  # [..., 2cand, 2, NL]
-    roots, ok = sqrt(g)
+
+def sswu_post(u, x1, x2, roots, ok):
+    """Post-sqrt half: candidate roots -> affine (x, y) with the RFC 9380
+    sign rule. ``roots``/``ok`` are sqrt outputs of ``sswu_pre``'s g."""
     is1 = ok[..., 0]
     x = fp2.select(is1, x1, x2)
     y = fp2.select(is1, roots[..., 0, :, :], roots[..., 1, :, :])
@@ -136,27 +141,57 @@ def map_to_curve_sswu(u):
     return x, y
 
 
+def map_to_curve_sswu(u):
+    """u: fp2 [..., 2, NL] -> affine (x, y) on the iso-curve E2'."""
+    x1, x2, g = sswu_pre(u)
+    roots, ok = sqrt(g)
+    return sswu_post(u, x1, x2, roots, ok)
+
+
 # ---------------------------------------------------------------------------
 # 3-isogeny E2' -> E2
 # ---------------------------------------------------------------------------
 
-def _horner(coeffs, x):
-    acc = jnp.broadcast_to(_dc(_fq2(coeffs[-1])), x.shape).astype(jnp.int32)
-    for c in reversed(coeffs[:-1]):
-        acc = fp2.add(
-            fp2.mul(acc, x),
-            jnp.broadcast_to(_dc(_fq2(c)), x.shape).astype(jnp.int32),
-        )
-    return acc
+def _iso3_coeff_table() -> np.ndarray:
+    """All four isogeny polynomials padded to a common degree and stacked:
+    int32 [max_len, 4, 2, NL], highest coefficient first (Horner order).
+    Zero-padding the short polynomial at the top degree is exact
+    (0*x + c)."""
+    import numpy as _np
+
+    polys = [iso3_g2.X_NUM, iso3_g2.X_DEN, iso3_g2.Y_NUM, iso3_g2.Y_DEN]
+    n = max(len(p) for p in polys)
+    out = _np.zeros((n, 4, 2, fp.NL), _np.int32)
+    for j, poly in enumerate(polys):
+        padded = list(poly) + [(0, 0)] * (n - len(poly))
+        for d, c in enumerate(reversed(padded)):  # MSB-first for Horner
+            q = _fq2(c)
+            out[d, j, 0] = fp.int_to_limbs(q.c0.n)
+            out[d, j, 1] = fp.int_to_limbs(q.c1.n)
+    return out
+
+
+_ISO3_TABLE = _iso3_coeff_table()
 
 
 def iso3_map(x, y):
-    """Derived 3-isogeny (coefficients from ``tools/derive_iso3.py``);
-    the two denominator inverses share one batched fp2.inv."""
-    xn = _horner(iso3_g2.X_NUM, x)
-    xd = _horner(iso3_g2.X_DEN, x)
-    yn = _horner(iso3_g2.Y_NUM, x)
-    yd = _horner(iso3_g2.Y_DEN, x)
+    """Derived 3-isogeny (coefficients from ``tools/derive_iso3.py``).
+    All four polynomials are evaluated by ONE Horner scan over a stacked
+    coefficient table (one fp2.mul body instead of ~11 — compile-size
+    lever), and the two denominator inverses share one batched fp2.inv."""
+    from jax import lax
+
+    table = jnp.asarray(_ISO3_TABLE)  # [deg, 4, 2, NL]
+    x4 = jnp.broadcast_to(
+        x[..., None, :, :], (*x.shape[:-2], 4, 2, fp.NL)
+    ).astype(jnp.int32)
+    acc0 = jnp.broadcast_to(table[0], x4.shape).astype(jnp.int32)
+
+    def body(acc, c):
+        return fp2.add(fp2.mul(acc, x4), jnp.broadcast_to(c, x4.shape)), None
+
+    acc, _ = lax.scan(body, acc0, table[1:])
+    xn, xd, yn, yd = (acc[..., j, :, :] for j in range(4))
     dens = fp2.inv(jnp.stack([xd, yd], axis=-3))
     x_out = fp2.mul(xn, dens[..., 0, :, :])
     y_out = fp2.mul(fp2.mul(y, yn), dens[..., 1, :, :])
@@ -179,11 +214,21 @@ def psi_jac(pt):
 
 
 def clear_cofactor(pt):
-    """[X^2-X-1]P + [X-1]psi(P) + psi^2([2]P) (RFC 9380 App. G.3)."""
-    xp = curve.scalar_mul_const(fp2, pt, X_ABS)
-    xp = curve.neg(fp2, xp)                      # [X]P, X < 0
-    x2p = curve.scalar_mul_const(fp2, xp, X_ABS)
-    x2p = curve.neg(fp2, x2p)                    # [X^2]P
+    """[X^2-X-1]P + [X-1]psi(P) + psi^2([2]P) (RFC 9380 App. G.3).
+
+    The two [X]-multiplications ([X]P, then [X][X]P) run through ONE
+    emitted scalar-mul body via an outer length-2 scan (the inner
+    double-and-add scan appears once in HLO — compile-size lever)."""
+    from jax import lax
+
+    def round_(carry, _):
+        q = curve.scalar_mul_const(fp2, carry, X_ABS)
+        q = curve.neg(fp2, q)                    # [X]·, X < 0
+        return q, q
+
+    _, qs = lax.scan(round_, pt, None, length=2)
+    xp = tuple(c[0] for c in qs)                 # [X]P
+    x2p = tuple(c[1] for c in qs)                # [X^2]P
     neg_p = curve.neg(fp2, pt)
     neg_xp = curve.neg(fp2, xp)
     part1 = curve.add(fp2, curve.add(fp2, x2p, neg_xp), neg_p)
@@ -196,15 +241,25 @@ def clear_cofactor(pt):
 # The batched map: u values -> G2 Jacobian points
 # ---------------------------------------------------------------------------
 
-def map_to_g2(u):
-    """u: fp2 [..., 2 (count), 2, NL] -> G2 Jacobian point [...] — the
-    full RO map: two SSWU maps, isogeny, one add, cofactor clearing."""
-    x, y = map_to_curve_sswu(u)          # batched over [..., 2]
+def map_to_g2_post(u, x1, x2, roots, ok):
+    """Post-sqrt remainder of the RO map: SSWU sign-pick, isogeny, the
+    count-axis add, cofactor clearing. ``roots/ok`` are sqrt outputs of
+    ``sswu_pre(u)``'s stacked g (callers may have merged that sqrt with
+    other square roots in the program)."""
+    x, y = sswu_post(u, x1, x2, roots, ok)
     x, y = iso3_map(x, y)
     q = curve.from_affine(fp2, x, y)
     q0 = tuple(c[..., 0, :, :] for c in q)
     q1 = tuple(c[..., 1, :, :] for c in q)
     return clear_cofactor(curve.add(fp2, q0, q1))
+
+
+def map_to_g2(u):
+    """u: fp2 [..., 2 (count), 2, NL] -> G2 Jacobian point [...] — the
+    full RO map: two SSWU maps, isogeny, one add, cofactor clearing."""
+    x1, x2, g = sswu_pre(u)              # batched over [..., 2]
+    roots, ok = sqrt(g)
+    return map_to_g2_post(u, x1, x2, roots, ok)
 
 
 # ---------------------------------------------------------------------------

@@ -260,22 +260,33 @@ def final_exp_is_one(f):
         tower.conjugate(b) if lam < 0 else b
         for b, lam in zip(bases, _LAM)
     ]
-    # subset-product table T[s] = prod_{i in s} bases[i], built with
-    # batched tower.mul per popcount level (3 calls total)
+    # subset-product table T[s] = prod_{i in s} bases[i], built by ONE
+    # scan over (dst, a, b) steps so the tower.mul body is emitted once
+    # (the popcount-level batched version emitted it three times);
+    # dependency order: every step's operands are already final.
     shape = f.shape
     one = jnp.broadcast_to(tower.ones(), shape).astype(jnp.int32)
-    T = {0: one, 1: bases[0], 2: bases[1], 4: bases[2], 8: bases[3]}
-    for level_sets in (
-        [(3, 1, 2), (5, 1, 4), (9, 1, 8), (6, 2, 4), (10, 2, 8), (12, 4, 8)],
-        [(7, 3, 4), (11, 3, 8), (13, 5, 8), (14, 6, 8)],
-        [(15, 7, 8)],
-    ):
-        lo = jnp.stack([T[a] for _, a, _ in level_sets])
-        hi = jnp.stack([T[b] for _, _, b in level_sets])
-        prod = tower.mul(lo, hi)
-        for j, (s, _, _) in enumerate(level_sets):
-            T[s] = prod[j]
-    table = jnp.stack([T[s] for s in range(16)])  # [16, ..., 2,3,2,NL]
+    table = jnp.stack(
+        [one, bases[0], bases[1], one, bases[2]]
+        + [one] * 3
+        + [bases[3]]
+        + [one] * 7
+    )  # [16, ..., 2,3,2,NL]; composite slots filled by the scan
+    steps = jnp.asarray(
+        [
+            (3, 1, 2), (5, 1, 4), (9, 1, 8), (6, 2, 4), (10, 2, 8),
+            (12, 4, 8), (7, 3, 4), (11, 3, 8), (13, 5, 8), (14, 6, 8),
+            (15, 7, 8),
+        ],
+        jnp.int32,
+    )
+
+    def build(T, step):
+        d, a, b = step[0], step[1], step[2]
+        prod = tower.mul(jnp.take(T, a, axis=0), jnp.take(T, b, axis=0))
+        return lax.dynamic_update_index_in_dim(T, prod, d, axis=0), None
+
+    table, _ = lax.scan(build, table, steps)
 
     idx = jnp.asarray(_MULTIEXP_IDX)
     acc0 = jnp.take(table, idx[0], axis=0)

@@ -496,15 +496,7 @@ class BeaconApiServer:
             net = getattr(chain, "network", None)
             peers = []
             if net is not None:
-                for peer in net.transport.peers:
-                    peers.append(
-                        {
-                            "peer_id": f"{peer.addr[0]}:{peer.remote_listen_port or peer.addr[1]}",
-                            "last_seen_p2p_address": f"/ip4/{peer.addr[0]}/tcp/{peer.addr[1]}",
-                            "state": "connected",
-                            "direction": "outbound",
-                        }
-                    )
+                peers = [_peer_json(p) for p in net.transport.peers_snapshot()]
             return {"data": peers, "meta": {"count": len(peers)}}
 
         m = re.fullmatch(r"/eth/v1/beacon/light_client/bootstrap/([^/]+)", path)
@@ -571,6 +563,144 @@ class BeaconApiServer:
                     },
                 }
             }
+        if path == "/eth/v1/beacon/headers":
+            # canonical head when unfiltered; ?slot= / ?parent_root= list
+            # matching blocks across the fork-choice DAG (reference
+            # http_api/src/lib.rs:975 block_headers)
+            proto = chain.fork_choice.proto
+            head_root = chain.head_block_root
+            matches = []
+            if "slot" in query or "parent_root" in query:
+                want_slot = int(query["slot"]) if "slot" in query else None
+                want_parent = (
+                    bytes.fromhex(query["parent_root"][2:])
+                    if "parent_root" in query
+                    else None
+                )
+                for node in proto.nodes:
+                    if want_slot is not None and node.slot != want_slot:
+                        continue
+                    if want_parent is not None:
+                        p = proto.nodes[node.parent] if node.parent is not None else None
+                        if p is None or p.root != want_parent:
+                            continue
+                    matches.append(node.root)
+            else:
+                matches = [head_root]
+            out = []
+            for root in matches:
+                block = chain.store.get_block(root)
+                if block is None:
+                    continue
+                canonical = (
+                    proto.ancestor_at_slot(head_root, block.message.slot) == root
+                )
+                out.append(_header_json(root, block, canonical))
+            return {"data": out}
+        m = re.fullmatch(r"/eth/v1/beacon/blocks/([^/]+)/root", path)
+        if m:
+            root, _ = self._block_for(m.group(1))
+            return {"data": {"root": "0x" + root.hex()}}
+        m = re.fullmatch(r"/eth/v1/beacon/blocks/([^/]+)/attestations", path)
+        if m:
+            root, block = self._block_for(m.group(1))
+            return {
+                "version": _fork_of_block(t, block),
+                "data": [
+                    to_json(type(a), a)
+                    for a in block.message.body.attestations
+                ],
+            }
+        m = re.fullmatch(
+            r"/eth/v1/beacon/states/([^/]+)/validators/([^/]+)", path
+        )
+        if m:
+            st = self._state_for(m.group(1))
+            vid = m.group(2)
+            idx = None
+            if vid.startswith("0x"):
+                try:
+                    pk = bytes.fromhex(vid[2:])
+                except ValueError:
+                    raise ApiError(400, f"malformed pubkey {vid!r}")
+                for i, v in enumerate(st.validators):
+                    if bytes(v.pubkey) == pk:
+                        idx = i
+                        break
+            else:
+                try:
+                    idx = int(vid)
+                except ValueError:
+                    raise ApiError(400, f"malformed validator id {vid!r}")
+            if idx is None or not 0 <= idx < len(st.validators):
+                raise ApiError(404, f"validator {vid} not found")
+            v = st.validators[idx]
+            return {
+                "data": {
+                    "index": str(idx),
+                    "balance": str(st.balances[idx]),
+                    "status": _validator_status(chain.preset, st, v),
+                    "validator": to_json(type(v), v),
+                }
+            }
+        if path == "/eth/v1/beacon/deposit_snapshot":
+            # EIP-4881 deposit-tree snapshot (reference :1657); served from
+            # the eth1 service's incremental tree when wired
+            eth1 = getattr(chain, "eth1", None)
+            if eth1 is None:
+                raise ApiError(404, "no eth1 service attached")
+            with eth1._lock:
+                count = len(eth1.deposits)
+                tree = eth1.deposit_tree
+                root = tree.root(count)
+                # EIP-4881: roots of the complete left subtrees covering
+                # `count` leaves (one per set bit, high to low)
+                finalized = []
+                acc = 0
+                for d in range(len(tree.levels) - 1, -1, -1):
+                    if count & (1 << d):
+                        finalized.append(
+                            "0x" + tree._node(d, acc >> d, count).hex()
+                        )
+                        acc += 1 << d
+                # read under the SAME lock: a concurrent eth1 update must
+                # not advance the block pointer past the snapshotted count
+                blocks = eth1.blocks
+                last = blocks[-1] if blocks else None
+            return {
+                "data": {
+                    "finalized": finalized,
+                    "deposit_root": "0x" + root.hex(),
+                    "deposit_count": str(count),
+                    "execution_block_hash": (
+                        "0x" + last.hash.hex() if last else "0x" + "00" * 32
+                    ),
+                    "execution_block_height": str(last.number if last else 0),
+                }
+            }
+        if path == "/eth/v1/debug/beacon/heads":
+            # viable fork-choice leaves (reference :1821): nodes that are
+            # no other node's parent
+            proto = chain.fork_choice.proto
+            parents = {n.parent for n in proto.nodes if n.parent is not None}
+            out = [
+                {
+                    "slot": str(n.slot),
+                    "root": "0x" + n.root.hex(),
+                    "execution_optimistic": False,
+                }
+                for i, n in enumerate(proto.nodes)
+                if i not in parents
+            ]
+            return {"data": out}
+        m = re.fullmatch(r"/eth/v1/node/peers/([^/]+)", path)
+        if m:
+            net = getattr(chain, "network", None)
+            if net is not None:
+                for peer in net.transport.peers_snapshot():
+                    if peer.node_id == m.group(1):
+                        return {"data": _peer_json(peer)}
+            raise ApiError(404, f"peer {m.group(1)} not known")
         m = re.fullmatch(r"/eth/v2/beacon/blocks/([^/]+)", path)
         if m:
             root, block = self._block_for(m.group(1))
@@ -1044,6 +1174,34 @@ def _validator_status(P, state, v) -> str:
     return "withdrawal_possible"
 
 
+def _header_json(root: bytes, block, canonical: bool) -> dict:
+    msg = block.message
+    return {
+        "root": "0x" + root.hex(),
+        "canonical": canonical,
+        "header": {
+            "message": {
+                "slot": str(msg.slot),
+                "proposer_index": str(msg.proposer_index),
+                "parent_root": "0x" + bytes(msg.parent_root).hex(),
+                "state_root": "0x" + bytes(msg.state_root).hex(),
+                "body_root": "0x" + hash_tree_root(msg.body).hex(),
+            },
+            "signature": "0x" + bytes(block.signature).hex(),
+        },
+    }
+
+
+def _peer_json(peer) -> dict:
+    return {
+        "peer_id": peer.node_id,
+        "last_seen_p2p_address": f"/ip4/{peer.addr[0]}/tcp/{peer.addr[1]}",
+        "state": "connected",
+        "direction": "outbound",
+        "enr": "",
+    }
+
+
 def _fork_of_block(t, signed_block) -> str:
     for fork, cls in t.signed_block.items():
         if isinstance(signed_block, cls):
@@ -1196,6 +1354,98 @@ def _block_rewards(chain, t, root, signed_block):
     }
 
 
+def _phase0_attestation_rewards(chain, state, indices) -> dict:
+    """Phase0 attestation rewards from PendingAttestations (un-501s the
+    route; reference computes the same from get_attestation_deltas —
+    ``consensus/state_processing/src/per_epoch_processing/base/rewards_and_penalties.rs``).
+    Per spec semantics: attested components earn the proportional reward,
+    missed components cost the full base reward (negative)."""
+    from ..state_transition.epoch import (
+        _base_reward_phase0,
+        _eligible_indices,
+        _is_in_inactivity_leak,
+        _matching_attestations,
+        _matching_head_attestations,
+        _matching_target_attestations,
+        _unslashed_attesting_indices,
+    )
+    from ..state_transition.helpers import (
+        get_previous_epoch,
+        get_total_active_balance,
+        get_total_balance,
+    )
+
+    P = chain.preset
+    previous = get_previous_epoch(P, state)
+    total = get_total_active_balance(P, state)
+    increment = P.EFFECTIVE_BALANCE_INCREMENT
+    in_leak = _is_in_inactivity_leak(P, state)
+    eligible = _eligible_indices(P, state)
+
+    if indices:
+        want = [int(i) for i in indices]
+        n = len(state.validators)
+        for i in want:
+            if not 0 <= i < n:
+                raise ApiError(400, f"validator index {i} out of range")
+    else:
+        want = eligible
+
+    comps = {}
+    ideal_by_eff: dict[int, dict[str, int]] = {}
+    for name, atts in (
+        ("source", _matching_attestations(P, state, previous)),
+        ("target", _matching_target_attestations(P, state, previous)),
+        ("head", _matching_head_attestations(P, state, previous)),
+    ):
+        unslashed = set(_unslashed_attesting_indices(P, state, atts))
+        attesting_balance = get_total_balance(P, state, unslashed)
+        vals = {}
+        eligible_set = set(eligible)
+        for i in want:
+            if i not in eligible_set:
+                vals[i] = 0
+                continue
+            base = _base_reward_phase0(P, state, total, i)
+            if i in unslashed:
+                vals[i] = (
+                    base if in_leak
+                    else base * (attesting_balance // increment) // (total // increment)
+                )
+            else:
+                vals[i] = -base
+        comps[name] = vals
+        for i in eligible:
+            eff = int(state.validators[i].effective_balance)
+            base = _base_reward_phase0(P, state, total, i)
+            ideal_by_eff.setdefault(eff, {})[name] = (
+                base if in_leak
+                else base * (attesting_balance // increment) // (total // increment)
+            )
+
+    total_rewards = [
+        {
+            "validator_index": str(i),
+            "head": str(comps["head"][i]),
+            "target": str(comps["target"][i]),
+            "source": str(comps["source"][i]),
+            "inactivity": "0",
+        }
+        for i in want
+    ]
+    ideal = [
+        {
+            "effective_balance": str(eff),
+            "head": str(v.get("head", 0)),
+            "target": str(v.get("target", 0)),
+            "source": str(v.get("source", 0)),
+            "inactivity": "0",
+        }
+        for eff, v in sorted(ideal_by_eff.items())
+    ]
+    return {"data": {"ideal_rewards": ideal, "total_rewards": total_rewards}}
+
+
 def _attestation_rewards(chain, t, epoch: int, indices) -> dict:
     """Attestation rewards for ``epoch`` (reference http_api
     attestation-rewards route): per-validator source/target/head +
@@ -1205,9 +1455,13 @@ def _attestation_rewards(chain, t, epoch: int, indices) -> dict:
     from ..state_transition.state.epoch import altair_reward_components
 
     state = chain.head_state
-    if fork_of(state) == "phase0":
-        raise ApiError(501, "attestation rewards: altair+ only")
     cur = compute_epoch_at_slot(chain.preset, state.slot)
+    if fork_of(state) == "phase0":
+        if cur < epoch + 1:
+            raise ApiError(400, f"epoch {epoch} is not yet complete (current {cur})")
+        if cur > epoch + 1:
+            raise ApiError(501, "historical attestation rewards not supported")
+        return _phase0_attestation_rewards(chain, state, indices)
     # rewards for epoch E are defined once E is the PREVIOUS epoch of a
     # completed head (advancing a copy cannot conjure the attestations,
     # and an unbounded requested epoch would be a remote CPU sink)

@@ -16,7 +16,7 @@ from __future__ import annotations
 import threading
 import time
 
-from ..utils import metrics
+from ..utils import logging, metrics
 
 _PENALTIES = metrics.counter(
     "network_peer_penalties_total", "scoring penalties applied"
@@ -107,17 +107,19 @@ class PeerManager:
         # merge so a partial override cannot KeyError an unnamed class
         self.quotas = {**DEFAULT_RPC_QUOTAS, **(quotas or {})}
         self._lock = threading.Lock()
-        # Scores are keyed by the peer's REMOTE IP — the only identity an
-        # attacker cannot choose (the listen port arrives in the peer's
-        # own STATUS message, so keying on it would let a peer rotate
-        # itself a fresh score at will). A misbehaving peer that
-        # reconnects therefore resumes its decayed score, and bans are
-        # IP-bans, exactly like the reference peerdb's. NAT'd peers share
-        # a budget; the one-process simulator accepts the same collateral.
+        # Scores are keyed by the peer's NOISE IDENTITY (hash of its
+        # static key, Peer.node_id) — unforgeable without the private key,
+        # so a misbehaving peer that reconnects resumes its decayed score
+        # under the same identity, like the reference peerdb's
+        # PeerId-keyed records. Minting a fresh keypair buys a fresh
+        # score (sybil), which the reference accepts too; the IP is kept
+        # as fallback for identity-less callers (unit tests).
         self._peers: dict[str, _PeerState] = {}
         self._banned: dict[str, float] = {}          # ban key -> expiry
         self.on_disconnect = lambda peer: None       # set by the service
-        self.ban_key = lambda peer: peer.addr[0]
+        self.ban_key = (
+            lambda peer: getattr(peer, "node_id", None) or peer.addr[0]
+        )
 
     # -- lifecycle -------------------------------------------------------
 
@@ -194,6 +196,8 @@ class PeerManager:
             if key and key not in self._banned:
                 self._banned[key] = time.monotonic() + BAN_DURATION_S
                 _BANS.inc()
+                logging.log("warn", "peer banned", peer=key,
+                            score=st.score, offence=offence)
         if st.score <= DISCONNECT_THRESHOLD:
             # callback outside the lock would be cleaner, but peer.close()
             # only flags + closes a socket — no re-entry into the manager

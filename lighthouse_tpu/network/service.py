@@ -35,6 +35,21 @@ from .transport import KIND_GOSSIP, Peer, Transport
 
 _GOSSIP_RX = metrics.counter("network_gossip_received_total")
 _GOSSIP_TX = metrics.counter("network_gossip_published_total")
+_SYNC_BATCHES = metrics.counter(
+    "sync_range_batches_total", "range-sync batches fetched"
+)
+_SYNC_BLOCKS = metrics.counter(
+    "sync_range_blocks_total", "blocks imported by range sync"
+)
+_BACKFILL_BLOCKS = metrics.counter(
+    "sync_backfill_blocks_total", "blocks stored by backfill sync"
+)
+_LOOKUPS = metrics.counter(
+    "sync_block_lookups_total", "parent-chain lookups started"
+)
+_LOOKUP_BLOCKS = metrics.counter(
+    "sync_block_lookup_blocks_fetched_total", "blocks fetched by root"
+)
 
 ATTESTATION_SUBNET_COUNT = 64
 
@@ -118,6 +133,7 @@ class NetworkService:
         self._mesh_thread.start()
         self.sync = RangeSync(self)
         self.backfill = BackfillSync(self)
+        self.lookups = BlockLookups(self)
         from .discovery import Discovery
 
         self.discovery = Discovery(self).start()
@@ -330,9 +346,9 @@ class NetworkService:
                 fork = fork_of(self.chain.head_state)
                 sb = t.signed_block[fork].decode(payload)
 
-                def block_done(result, _fb=fb):
+                def block_done(result, _fb=fb, _sb=sb):
                     _fb(result)
-                    self._after_block(result)
+                    self._after_block(result, _sb)
 
                 self.processor.submit(
                     Work(WorkKind.GOSSIP_BLOCK, sb, done=block_done)
@@ -385,11 +401,14 @@ class NetworkService:
             for p in members:
                 p.send(KIND_GOSSIP, topic.encode(), payload)
 
-    def _after_block(self, result) -> None:
-        """Unknown-parent blocks trigger sync; others are done."""
+    def _after_block(self, result, sb=None) -> None:
+        """Unknown-parent blocks trigger an active parent lookup (and
+        range sync as the catch-up fallback); others are done."""
         from ..beacon_chain import BlockError
 
         if isinstance(result, BlockError) and result.kind == "ParentUnknown":
+            if sb is not None:
+                self.lookups.search(bytes(sb.message.parent_root), orphan=sb)
             self.sync.trigger()
 
     # -- req/resp --------------------------------------------------------
@@ -456,7 +475,7 @@ class NetworkService:
         if protocol == PROTO_PEER_EXCHANGE:
             peers = [
                 [p.addr[0], p.remote_listen_port]
-                for p in self.transport.peers
+                for p in self.transport.peers_snapshot()
                 if p.remote_listen_port
             ]
             return json.dumps(peers).encode()
@@ -487,6 +506,140 @@ class NetworkService:
                     out.append(struct.pack("<I", len(enc)) + enc)
             return b"".join(out)
         return b""
+
+
+class BlockLookups:
+    """Active unknown-parent block lookups (reference
+    ``network/src/sync/block_lookups``): when a gossip block references an
+    unknown parent, fetch the parent chain by root from the best-scored
+    peers (retry across peers, downscore bad responders), import the
+    recovered segment oldest-first, then replay the orphan. Range sync
+    only helps when a peer's STATUS shows it ahead; a same-height fork or
+    a missed gossip block needs this root-addressed path."""
+
+    MAX_CHAIN = 16   # parent-depth bound (reference PARENT_DEPTH_TOLERANCE)
+    PEER_TRIES = 3   # distinct peers asked per root before giving up
+    MAX_INFLIGHT = 8  # concurrent lookup threads (reference bounds these
+    #                   too: cheap ParentUnknown gossip must not fan out
+    #                   unbounded threads or by-root request storms)
+    NEG_CACHE_S = 30.0  # roots that failed recently are not re-searched
+
+    def __init__(self, service: NetworkService):
+        self.service = service
+        self._lock = threading.Lock()
+        self._inflight: set[bytes] = set()
+        self._neg_cache: dict[bytes, float] = {}
+        self._metric = _LOOKUPS
+        self._fetched = _LOOKUP_BLOCKS
+
+    def search(self, root: bytes, orphan=None) -> None:
+        """Fire-and-forget lookup of ``root`` and its unknown ancestors;
+        ``orphan`` (the block whose parent is missing) is replayed after
+        the segment imports."""
+        chain = self.service.chain
+        now = time.monotonic()
+        with self._lock:
+            if root in self._inflight or len(self._inflight) >= self.MAX_INFLIGHT:
+                return
+            if self._neg_cache.get(root, 0.0) > now:
+                return
+            if len(self._neg_cache) > 1024:
+                self._neg_cache = {
+                    k: t for k, t in self._neg_cache.items() if t > now
+                }
+            self._inflight.add(root)
+        if chain.store.get_block(root) is not None:
+            with self._lock:
+                self._inflight.discard(root)
+            return
+        self._metric.inc()
+        threading.Thread(
+            target=self._run, args=(root, orphan), daemon=True
+        ).start()
+
+    # -- internals -------------------------------------------------------
+
+    def _best_peers(self) -> list[Peer]:
+        pm = self.service.peer_manager
+        peers = [
+            p for p in self.service.transport.peers_snapshot() if not p.closed
+        ]
+        return sorted(peers, key=pm.score, reverse=True)
+
+    def _request_block(self, root: bytes):
+        """Ask up to PEER_TRIES best peers for one block by root; verify
+        the response IS the requested block (hash_tree_root) and
+        downscore peers that answer with garbage."""
+        for peer in self._best_peers()[: self.PEER_TRIES]:
+            raw = peer.request(PROTO_BLOCKS_BY_ROOT.encode(), root, timeout=10)
+            if not raw:
+                continue  # empty/timeout: try the next peer, no penalty
+            try:
+                (n,) = struct.unpack_from("<I", raw, 0)
+                chunk = raw[4:4 + n]
+                t = self.service.chain.types
+                sb = None
+                for fork in ("bellatrix", "altair", "phase0"):
+                    try:
+                        sb = t.signed_block[fork].decode(chunk)
+                        break
+                    except Exception:
+                        continue
+                if sb is None or hash_tree_root(sb.message) != root:
+                    raise ValueError("wrong or undecodable block")
+            except Exception:
+                self.service.peer_manager.report(peer, "protocol")
+                continue
+            self._fetched.inc()
+            return sb
+        return None
+
+    def _run(self, root: bytes, orphan) -> None:
+        try:
+            chain = self.service.chain
+            segment = []  # newest -> oldest
+            want = root
+            for _ in range(self.MAX_CHAIN):
+                if want == bytes(32) or chain.store.get_block(want) is not None:
+                    break
+                sb = self._request_block(want)
+                if sb is None:
+                    # nobody could serve it: negative-cache so repeat
+                    # ParentUnknown gossip cannot re-trigger immediately
+                    with self._lock:
+                        self._neg_cache[root] = (
+                            time.monotonic() + self.NEG_CACHE_S
+                        )
+                    return
+                segment.append(sb)
+                want = bytes(sb.message.parent_root)
+            else:
+                # chain deeper than the bound: that is range sync's job
+                self.service.sync.trigger()
+                return
+            if not segment:
+                return
+            segment.reverse()  # oldest first for CHAIN_SEGMENT
+            done = threading.Event()
+            result = {}
+
+            def _done(r, _ev=done, _res=result):
+                _res["r"] = r
+                _ev.set()
+
+            self.service.processor.submit(
+                Work(WorkKind.CHAIN_SEGMENT, segment, done=_done)
+            )
+            if not done.wait(timeout=60) or isinstance(result.get("r"), Exception):
+                return
+            if orphan is not None:
+                # replay the orphan now that its ancestry is in the store
+                self.service.processor.submit(
+                    Work(WorkKind.GOSSIP_BLOCK, orphan, done=lambda r: None)
+                )
+        finally:
+            with self._lock:
+                self._inflight.discard(root)
 
 
 class BackfillSync:
@@ -577,6 +730,7 @@ class BackfillSync:
             for root, sb in verified:
                 chain.store.put_block(root, sb)
                 stored += 1
+            _BACKFILL_BLOCKS.inc(len(verified))
             next_below = verified[-1][1].message.slot
             if verified[-1][1].message.slot == 0:
                 break
@@ -663,6 +817,8 @@ class RangeSync:
                 blocks = self._decode_blocks(raw)
                 if not blocks:
                     return
+                _SYNC_BATCHES.inc()
+                _SYNC_BLOCKS.inc(len(blocks))
                 done = threading.Event()
                 result = {}
 

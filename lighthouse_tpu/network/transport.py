@@ -1,19 +1,25 @@
-"""TCP transport: framed, snappy-compressed messages between peers.
+"""TCP transport: Noise-encrypted, framed, snappy-compressed messages.
 
-Reference: ``beacon_node/lighthouse_network`` — libp2p over TCP with
-gossipsub (snappy-compressed SSZ payloads) and SSZ-snappy req/resp
-(``src/rpc/protocol.rs:143-220``, codec ``rpc/codec/ssz_snappy.rs``).
+Reference: ``beacon_node/lighthouse_network`` — libp2p over TCP with a
+Noise session layer, gossipsub (snappy-compressed SSZ payloads) and
+SSZ-snappy req/resp (``src/rpc/protocol.rs:143-220``, codec
+``rpc/codec/ssz_snappy.rs``).
 
 This transport keeps the reference's WIRE SEMANTICS (topic strings,
 SSZ-snappy payloads, request/response protocol names) over a simple
-length-prefixed TCP framing instead of libp2p's multistream negotiation:
+length-prefixed TCP framing instead of libp2p's multistream negotiation.
+Every connection starts with a **Noise XX handshake** (``noise.py``):
+mutual static-key authentication, after which each frame is sealed
+end-to-end:
 
-    frame := u32-le total_len | u8 kind | u16-le name_len | u32-le req_id
-             | name | payload
+    wire  := u32-le ct_len | AEAD(frame)
+    frame := u8 kind | u16-le name_len | u32-le req_id | name | payload
 
 kind: 0 = gossip publish (name = topic, req_id = 0), 1 = rpc request,
 2 = rpc response (req_id echoes the request so late responses can never
 be mis-delivered to a newer request). Payloads are snappy raw blocks.
+``Peer.node_id`` (hash of the remote static key) is the identity peer
+scoring and bans key on — spoofing it requires the private key.
 """
 
 from __future__ import annotations
@@ -26,22 +32,29 @@ from dataclasses import dataclass
 from typing import Callable, Optional
 
 from ..utils import snappy
+from . import noise
 
 KIND_GOSSIP = 0
 KIND_REQUEST = 1
 KIND_RESPONSE = 2
 
-_HDR = struct.Struct("<IBHI")
+_HDR = struct.Struct("<BHI")
+_LEN = struct.Struct("<I")
 MAX_FRAME = 1 << 24  # 16 MiB ceiling, like the reference's max_chunk_size
 MAX_INFLIGHT_HANDLERS = 4  # concurrent request handlers per peer
+HANDSHAKE_TIMEOUT_S = 5.0
 
 
 class Peer:
-    """One connected remote; owns the socket + reader thread."""
+    """One authenticated remote; owns the socket + reader thread. Created
+    only AFTER the Noise handshake succeeded (``session``)."""
 
-    def __init__(self, sock: socket.socket, addr, on_frame, on_close):
+    def __init__(self, sock: socket.socket, addr, on_frame, on_close,
+                 session: noise.Session):
         self.sock = sock
         self.addr = addr
+        self.session = session
+        self.node_id = session.remote_node_id
         self.remote_listen_port: Optional[int] = None
         self._on_frame = on_frame
         self._on_close = on_close
@@ -60,12 +73,15 @@ class Peer:
 
     def send(self, kind: int, name: bytes, payload: bytes, req_id: int = 0) -> bool:
         comp = snappy.compress_raw(payload)
-        frame = _HDR.pack(1 + 2 + 4 + len(name) + len(comp), kind, len(name), req_id)
+        frame = _HDR.pack(kind, len(name), req_id) + name + comp
         try:
+            # encrypt INSIDE the lock: the AEAD nonce is a strict counter,
+            # so ciphertexts must hit the socket in encryption order
             with self._send_lock:
-                self.sock.sendall(frame + name + comp)
+                ct = self.session.send.encrypt(frame)
+                self.sock.sendall(_LEN.pack(len(ct)) + ct)
             return True
-        except OSError:
+        except (OSError, noise.HandshakeError):
             self.close()
             return False
 
@@ -125,14 +141,22 @@ class Peer:
     def _read_loop(self) -> None:
         try:
             while True:
-                hdr = self._read_exact(_HDR.size)
-                if hdr is None:
+                ln_raw = self._read_exact(_LEN.size)
+                if ln_raw is None:
                     break
-                total, kind, name_len, req_id = _HDR.unpack(hdr)
-                if total > MAX_FRAME or name_len > total:
+                (ct_len,) = _LEN.unpack(ln_raw)
+                if ct_len > MAX_FRAME or ct_len < _HDR.size + noise.TAGLEN:
                     break
-                body = self._read_exact(total - 1 - 2 - 4)
-                if body is None:
+                ct = self._read_exact(ct_len)
+                if ct is None:
+                    break
+                try:
+                    frame = self.session.recv.decrypt(ct)
+                except noise.HandshakeError:
+                    break  # tampered/replayed ciphertext: kill the session
+                kind, name_len, req_id = _HDR.unpack(frame[: _HDR.size])
+                body = frame[_HDR.size:]
+                if name_len > len(body):
                     break
                 name = body[:name_len]
                 try:
@@ -171,10 +195,13 @@ class Peer:
 
 
 class Transport:
-    """Listener + peer set. ``on_gossip(peer, topic, payload)``,
-    ``on_request(peer, protocol, payload) -> bytes`` (the response)."""
+    """Listener + authenticated peer set. ``on_gossip(peer, topic,
+    payload)``, ``on_request(peer, protocol, payload) -> bytes``."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 identity: noise.Identity | None = None):
+        self.identity = identity or noise.Identity()
+        self.node_id = self.identity.node_id
         self.on_gossip: Callable = lambda *a: None
         self.on_request: Callable = lambda *a: b""
         self.on_peer_connected: Callable = lambda peer: None
@@ -199,12 +226,24 @@ class Transport:
                     return p
         try:
             sock = socket.create_connection((host, port), timeout=5)
-            # the connect timeout must not linger: it would turn any 5s
-            # idle period into a recv timeout that kills the connection
-            sock.settimeout(None)
         except OSError:
             return None
-        peer = self._add_peer(sock, (host, port))
+        try:
+            sock.settimeout(HANDSHAKE_TIMEOUT_S)
+            session = noise.handshake_initiator(sock, self.identity)
+            # the handshake timeout must not linger: it would turn any 5s
+            # idle period into a recv timeout that kills the connection
+            sock.settimeout(None)
+        except (OSError, noise.HandshakeError):
+            try:
+                sock.close()  # a failed handshake must not leak the fd
+            except OSError:
+                pass
+            return None
+        if session.remote_node_id == self.node_id:
+            sock.close()  # self-dial (or key reuse): refuse
+            return None
+        peer = self._add_peer(sock, (host, port), session)
         peer.remote_listen_port = port
         self.on_peer_connected(peer)
         return peer
@@ -221,14 +260,34 @@ class Transport:
                 # so persistent errors (fd exhaustion) cannot busy-spin
                 time.sleep(0.05)
                 continue
-            peer = self._add_peer(sock, addr)
-            try:
-                self.on_peer_connected(peer)
-            except Exception:
-                peer.close()  # a handler bug must not kill the accept loop
+            # handshake runs off the accept loop: a stalling dialer must
+            # not block further accepts (libp2p upgrades concurrently too)
+            threading.Thread(
+                target=self._handshake_inbound, args=(sock, addr), daemon=True
+            ).start()
 
-    def _add_peer(self, sock: socket.socket, addr) -> Peer:
-        peer = Peer(sock, addr, self._dispatch, self._remove_peer)
+    def _handshake_inbound(self, sock: socket.socket, addr) -> None:
+        try:
+            sock.settimeout(HANDSHAKE_TIMEOUT_S)
+            session = noise.handshake_responder(sock, self.identity)
+            sock.settimeout(None)
+        except (OSError, noise.HandshakeError):
+            try:
+                sock.close()
+            except OSError:
+                pass
+            return
+        if not self._running or session.remote_node_id == self.node_id:
+            sock.close()
+            return
+        peer = self._add_peer(sock, addr, session)
+        try:
+            self.on_peer_connected(peer)
+        except Exception:
+            peer.close()  # a handler bug must not kill the accept path
+
+    def _add_peer(self, sock: socket.socket, addr, session: noise.Session) -> Peer:
+        peer = Peer(sock, addr, self._dispatch, self._remove_peer, session)
         with self._lock:
             self.peers.append(peer)
         return peer

@@ -13,6 +13,16 @@ Holds gossip-learned operations for block inclusion:
 from __future__ import annotations
 
 import threading
+
+from ..utils import metrics
+
+_ATTS = metrics.gauge("op_pool_attestations", "pending attestation groups")
+_EXITS = metrics.gauge("op_pool_voluntary_exits", "pending voluntary exits")
+_ASLASH = metrics.gauge("op_pool_attester_slashings", "pending attester slashings")
+_PSLASH = metrics.gauge("op_pool_proposer_slashings", "pending proposer slashings")
+_PACKING = metrics.histogram(
+    "op_pool_packing_seconds", "max-cover block packing latency"
+)
 from dataclasses import dataclass
 
 from ..crypto import bls
@@ -59,6 +69,14 @@ class OperationPool:
 
     # -- attestations ----------------------------------------------------
 
+    def _update_size_gauges(self) -> None:
+        # caller holds self._lock (reference: op-pool size metrics,
+        # beacon_chain/src/metrics.rs OP_POOL_* families)
+        _ATTS.set(sum(len(groups) for _, groups in self._attestations.values()))
+        _EXITS.set(len(self._voluntary_exits))
+        _ASLASH.set(len(self._attester_slashings))
+        _PSLASH.set(len(self._proposer_slashings))
+
     def insert_attestation(self, attestation) -> None:
         """Greedy on-insert aggregation (reference
         ``attestation_storage.rs`` ``aggregate``/``insert``): merge into
@@ -85,6 +103,7 @@ class OperationPool:
             groups.append(
                 _CompactAttestation(bits, bytes(attestation.signature))
             )
+            self._update_size_gauges()
 
     def n_attestations(self) -> int:
         with self._lock:
@@ -150,16 +169,19 @@ class OperationPool:
             self._proposer_slashings.setdefault(
                 slashing.signed_header_1.message.proposer_index, slashing
             )
+            self._update_size_gauges()
 
     def insert_attester_slashing(self, slashing) -> None:
         with self._lock:
             self._attester_slashings.append(slashing)
+            self._update_size_gauges()
 
     def insert_voluntary_exit(self, signed_exit) -> None:
         with self._lock:
             self._voluntary_exits.setdefault(
                 signed_exit.message.validator_index, signed_exit
             )
+            self._update_size_gauges()
 
     def _slashable_indices(self, slashing, state) -> dict:
         a = set(slashing.attestation_1.attesting_indices)
@@ -288,6 +310,10 @@ class OperationPool:
         )
 
     def packing_for_block(self, chain, state) -> dict:
+        with _PACKING.time():
+            return self._packing_for_block(chain, state)
+
+    def _packing_for_block(self, chain, state) -> dict:
         """Everything the block body takes from the pool (reference
         ``produce_block_on_state`` op-pool calls)."""
         P = self.preset

@@ -31,6 +31,16 @@ from ..ssz import hash_tree_root
 _NO_SPAN = np.iinfo(np.int64).max
 
 
+from ..utils import metrics
+
+_BATCH_TIME = metrics.histogram(
+    "slasher_batch_seconds", "queued-attestation batch processing latency"
+)
+_SLASHINGS = metrics.counter(
+    "slasher_slashings_found_total", "attester/proposer slashings detected"
+)
+
+
 def _b64(v: int) -> bytes:
     return int(v).to_bytes(8, "big")
 
@@ -111,6 +121,10 @@ class Slasher:
             self._queue.append(indexed_attestation)
 
     def process_queued(self) -> int:
+        with _BATCH_TIME.time():
+            return self._process_queued()
+
+    def _process_queued(self) -> int:
         """Periodic batch processing (reference
         ``slasher/service/src/service.rs``). Returns #slashings found."""
         with self._lock:
@@ -151,6 +165,7 @@ class Slasher:
                         attestation_1=first, attestation_2=second
                     )
                     self.found_attester_slashings.append(slashing)
+                    _SLASHINGS.inc()
                     out.append((status, slashing))
                     if self.on_slashing:
                         self.on_slashing(status, indexed, old)
@@ -263,6 +278,7 @@ class Slasher:
                     signed_header_1=prev[1], signed_header_2=signed_header
                 )
                 self.found_proposer_slashings.append(slashing)
+                _SLASHINGS.inc()
         if slashing is not None and self.on_slashing:
             self.on_slashing("double_proposal", signed_header, prev[1])
         self.flush()

@@ -1,0 +1,201 @@
+"""Freezer-grade restore-point storage (VERDICT r4 item #5; reference:
+``beacon_node/store/src/chunked_vector.rs`` + ``partial_beacon_state.rs``).
+
+The naive freezer stored a FULL SSZ snapshot per restore point — at
+mainnet scale that is ~15 MB each, dominated by content that is either
+shared between consecutive restore points or already present in the
+per-slot cold index. This layout splits a restore-point state into:
+
+* **vector fields reconstructed from global per-slot/epoch columns** —
+  ``block_roots[s % W]`` / ``state_roots[s % W]`` are exactly the
+  ``COLD_BLOCK_ROOTS`` / ``COLD_STATE_ROOTS`` entries the migrate walk
+  already writes (the reference's chunked_vector insight: one global
+  copy per slot, not one per state); ``randao_mixes`` gets its own
+  per-epoch ``COLD_RANDAO`` column (final mix of each completed epoch).
+  Window entries not covered (pre-genesis fill, the in-progress current
+  epoch) ride along as explicit exceptions.
+* **an interned validator-record table** — each distinct Validator SSZ
+  record is stored ONCE globally (``COLD_VREC``, id-keyed;
+  ``COLD_VREC_INDEX`` maps record-hash -> id); a restore point stores
+  u32 ids. Records change only on activation/exit/slashing/eff-balance
+  steps, so consecutive restore points share almost the whole table —
+  without diff chains, so loading any restore point stays O(1).
+* **packed balances** — raw little-endian u64 array (the one field that
+  genuinely changes every epoch for every validator).
+* **the partial state** — the full state SSZ with the above fields
+  emptied/zeroed, carrying every small field verbatim.
+
+``put_restore_point`` / ``load_restore_point`` round-trip bit-exactly
+(asserted by tests against hash_tree_root).
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+from typing import Optional
+
+from ..ssz import hash_tree_root
+from ..ssz.sha256 import hash_bytes
+from ..state_transition.epoch import fork_of
+from ..types.containers import FORK_IDS as _FORK_IDS, FORK_NAMES as _FORK_NAMES
+from .kv import Column
+
+_NEXT_VREC_KEY = b"next_vrec_id"
+
+
+def _intern_validators(kv, validators) -> bytes:
+    """Validator records -> packed u32 ids, interning new records."""
+    raw_next = kv.get(Column.METADATA, _NEXT_VREC_KEY)
+    next_id = struct.unpack("<I", raw_next)[0] if raw_next else 0
+    ids = []
+    new_recs = []
+    for v in validators:
+        enc = type(v).encode(v)
+        h = hash_bytes(enc)[:16]
+        known = kv.get(Column.COLD_VREC_INDEX, h)
+        if known is None:
+            vid = next_id
+            next_id += 1
+            known = struct.pack("<I", vid)
+            new_recs.append((Column.COLD_VREC, known, enc))
+            new_recs.append((Column.COLD_VREC_INDEX, h, known))
+        ids.append(known)
+    if new_recs:
+        new_recs.append(
+            (Column.METADATA, _NEXT_VREC_KEY, struct.pack("<I", next_id))
+        )
+        kv.put_batch(new_recs)
+    return b"".join(ids)
+
+
+def _restore_validators(kv, types, ids_blob: bytes):
+    vcls = types.Validator
+    out = []
+    for i in range(0, len(ids_blob), 4):
+        rec = kv.get(Column.COLD_VREC, ids_blob[i:i + 4])
+        if rec is None:
+            raise KeyError(f"missing validator record id at offset {i}")
+        out.append(vcls.decode(rec))
+    return out
+
+
+def put_restore_point(kv, types, state_root: bytes, state) -> None:
+    """Store ``state`` as a chunked restore point under ``state_root``."""
+    preset = types.preset
+    W = preset.SLOTS_PER_HISTORICAL_ROOT
+    N = preset.EPOCHS_PER_HISTORICAL_VECTOR
+    spe = preset.SLOTS_PER_EPOCH
+    slot = int(state.slot)
+    epoch = slot // spe
+
+    # global per-epoch randao column: final mixes of completed epochs in
+    # this state's window (idempotent; only missing keys are written)
+    batch = []
+    for e in range(max(0, epoch - N + 1), epoch):
+        key = struct.pack("<Q", e)
+        if kv.get(Column.COLD_RANDAO, key) is None:
+            batch.append(
+                (Column.COLD_RANDAO, key, bytes(state.randao_mixes[e % N]))
+            )
+    if batch:
+        kv.put_batch(batch)
+
+    # randao exceptions: indices whose epoch is pre-genesis (genesis fill)
+    # or the in-progress current epoch
+    exceptions = []
+    for e in range(epoch - N + 1, epoch + 1):
+        if e < 0 or e == epoch:
+            idx = e % N
+            exceptions.append(struct.pack("<I", idx) + bytes(state.randao_mixes[idx]))
+
+    ids_blob = _intern_validators(kv, state.validators)
+    balances_blob = struct.pack(f"<{len(state.balances)}Q", *state.balances)
+
+    # partial state: big fields emptied/zeroed, then restored (callers
+    # may hold the state object)
+    saved = (
+        state.validators, state.balances, state.block_roots,
+        state.state_roots, state.randao_mixes,
+    )
+    zero = b"\x00" * 32
+    try:
+        state.validators = []
+        state.balances = []
+        state.block_roots = [zero] * W
+        state.state_roots = [zero] * W
+        state.randao_mixes = [zero] * N
+        partial = type(state).encode(state)
+    finally:
+        (state.validators, state.balances, state.block_roots,
+         state.state_roots, state.randao_mixes) = saved
+
+    blob = b"".join(
+        [
+            bytes([_FORK_IDS[fork_of(state)]]),
+            struct.pack("<III", len(ids_blob), len(balances_blob),
+                        len(exceptions)),
+            ids_blob,
+            balances_blob,
+            b"".join(exceptions),
+            partial,
+        ]
+    )
+    # zlib (the in-repo snappy is literal-only wire framing): the zeroed
+    # vector fields inside `partial` and the genesis randao exceptions
+    # early in the chain collapse to run-length tokens
+    kv.put(Column.COLD_PARTIAL, state_root, zlib.compress(blob, 6))
+
+
+def load_restore_point(kv, types, state_root: bytes,
+                       cold_block_root_at_slot, cold_state_root_at_slot):
+    """Reassemble a chunked restore point; None if absent."""
+    blob = kv.get(Column.COLD_PARTIAL, state_root)
+    if blob is None:
+        return None
+    blob = zlib.decompress(blob)
+    fork = _FORK_NAMES[blob[0]]
+    n_ids, n_bal, n_exc = struct.unpack_from("<III", blob, 1)
+    off = 13
+    ids_blob = blob[off:off + n_ids]
+    off += n_ids
+    balances_blob = blob[off:off + n_bal]
+    off += n_bal
+    exceptions = []
+    for _ in range(n_exc):
+        (idx,) = struct.unpack_from("<I", blob, off)
+        exceptions.append((idx, blob[off + 4:off + 36]))
+        off += 36
+    state = types.state[fork].decode(blob[off:])
+
+    preset = types.preset
+    W = preset.SLOTS_PER_HISTORICAL_ROOT
+    N = preset.EPOCHS_PER_HISTORICAL_VECTOR
+    spe = preset.SLOTS_PER_EPOCH
+    slot = int(state.slot)
+    epoch = slot // spe
+
+    state.validators = _restore_validators(kv, types, ids_blob)
+    state.balances = list(struct.unpack(f"<{n_bal // 8}Q", balances_blob))
+
+    block_roots = list(state.block_roots)
+    state_roots = list(state.state_roots)
+    for s in range(max(0, slot - W), slot):
+        br = cold_block_root_at_slot(s)
+        if br is not None:
+            block_roots[s % W] = br
+        sr = cold_state_root_at_slot(s)
+        if sr is not None:
+            state_roots[s % W] = sr
+    state.block_roots = block_roots
+    state.state_roots = state_roots
+
+    mixes = list(state.randao_mixes)
+    for e in range(max(0, epoch - N + 1), epoch):
+        raw = kv.get(Column.COLD_RANDAO, struct.pack("<Q", e))
+        if raw is not None:
+            mixes[e % N] = raw
+    for idx, val in exceptions:
+        mixes[idx] = val
+    state.randao_mixes = mixes
+    return state

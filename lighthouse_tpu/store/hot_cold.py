@@ -28,7 +28,22 @@ from typing import Callable, Iterator, Optional
 from ..ssz import hash_tree_root
 from ..state_transition.epoch import fork_of
 from ..types.containers import FORK_IDS as _FORK_IDS, FORK_NAMES as _FORK_NAMES
+from ..utils import metrics
 from .kv import Column, KeyValueStore
+
+_STATE_READS = metrics.histogram(
+    "store_state_read_seconds", "get_state latency incl. any replay"
+)
+_STATE_REPLAYS = metrics.counter(
+    "store_state_replays_total", "states rebuilt by block replay"
+)
+_BLOCK_READS = metrics.counter("store_block_reads_total", "get_block calls")
+_MIGRATE_TIME = metrics.histogram(
+    "store_migrate_seconds", "freezer migration latency"
+)
+_DB_SIZE = metrics.gauge(
+    "store_db_size_bytes", "approximate database size (0 if unknown)"
+)
 
 _SPLIT_KEY = b"split"
 _HEAD_KEY = b"head"
@@ -97,6 +112,7 @@ class HotColdDB:
         self.kv.put(Column.BLOCK, block_root, data)
 
     def get_block(self, block_root: bytes):
+        _BLOCK_READS.inc()
         data = self.kv.get(Column.BLOCK, block_root)
         if data is None:
             return None
@@ -141,14 +157,16 @@ class HotColdDB:
     def get_state(self, state_root: bytes):
         """Load a state: hot snapshot directly, hot summary via replay,
         frozen states via restore-point + cold-index replay."""
-        state = self._get_state_full(Column.STATE, state_root)
-        if state is not None:
-            return state
-        raw = self.kv.get(Column.STATE_SUMMARY, state_root)
-        if raw is None:
-            return self._load_cold_state(state_root)
-        summary = StateSummary.decode(raw)
-        return self._replay_to(summary)
+        with _STATE_READS.time():
+            state = self._get_state_full(Column.STATE, state_root)
+            if state is not None:
+                return state
+            raw = self.kv.get(Column.STATE_SUMMARY, state_root)
+            if raw is None:
+                return self._load_cold_state(state_root)
+            summary = StateSummary.decode(raw)
+            _STATE_REPLAYS.inc()
+            return self._replay_to(summary)
 
     def _replay_to(self, summary: StateSummary):
         """Walk summaries back to a snapshot, collect the block chain in
@@ -169,7 +187,7 @@ class HotColdDB:
                 seen_root = cur.latest_block_root
             base = self._get_state_full(Column.STATE, cur.previous_state_root)
             if base is None:
-                base = self._get_state_full(Column.COLD_STATE, cur.previous_state_root)
+                base = self._get_cold_state(cur.previous_state_root)
             if base is not None:
                 chain = [b for b in reversed(blocks) if b.message.slot > base.slot]
                 return self.replayer(base, chain, summary.slot)
@@ -180,11 +198,27 @@ class HotColdDB:
                 )
             cur = StateSummary.decode(raw)
 
+    def _get_cold_state(self, state_root: bytes):
+        """Restore-point lookup across both freezer layouts: chunked
+        (COLD_PARTIAL, freezer.py) then legacy full SSZ (COLD_STATE)."""
+        from . import freezer
+
+        state = freezer.load_restore_point(
+            self.kv, self.types, state_root,
+            self.cold_block_root_at_slot, self._cold_state_root_at_slot,
+        )
+        if state is not None:
+            return state
+        return self._get_state_full(Column.COLD_STATE, state_root)
+
+    def _cold_state_root_at_slot(self, slot: int) -> Optional[bytes]:
+        return self.kv.get(Column.COLD_STATE_ROOTS, struct.pack("<Q", slot))
+
     def _load_cold_state(self, state_root: bytes):
         """Frozen state: restore point at or below + replay through the
         cold per-slot block index (reference ``hot_cold_store.rs``
         ``load_cold_state`` + state reconstruction)."""
-        state = self._get_state_full(Column.COLD_STATE, state_root)
+        state = self._get_cold_state(state_root)
         if state is not None:
             return state
         raw = self.kv.get(Column.COLD_STATE_SLOTS, state_root)
@@ -199,7 +233,7 @@ class HotColdDB:
                 Column.COLD_STATE_ROOTS, struct.pack("<Q", base_slot)
             )
             if base_root is not None:
-                base = self._get_state_full(Column.COLD_STATE, base_root)
+                base = self._get_cold_state(base_root)
             if base is None:
                 if base_slot == 0:
                     break
@@ -230,10 +264,13 @@ class HotColdDB:
         old_split = self.split_slot
         if new_split <= old_split:
             return
+        _timer = _MIGRATE_TIME.time()
+        _timer.__enter__()
 
         # Per-slot root indexes for the newly-frozen range, walked from the
         # finalized state backwards via summaries/snapshots.
         root = finalized_state_root
+        restore_points: list[bytes] = []
         while True:
             raw_sum = self.kv.get(Column.STATE_SUMMARY, root)
             full = self._get_state_full(Column.STATE, root)
@@ -258,15 +295,31 @@ class HotColdDB:
                 ]
             )
             if slot % self.slots_per_restore_point == 0:
-                # A restore-point slot stored as a hot summary must be
-                # materialized before the summaries are dropped, or the
-                # whole frozen range would lose its replay base.
-                if full is None:
-                    full = self.get_state(root)
-                self._put_state_full(Column.COLD_STATE, root, full)
+                restore_points.append(root)
             if slot == 0 or prev is None:
                 break
             root = prev
+
+        # Restore points are materialized AFTER the walk so the per-slot
+        # cold index covering their vector windows is complete, and BEFORE
+        # hot entries are dropped (their states load from hot summaries).
+        # Stored CHUNKED (freezer.py): vectors reconstruct from the cold
+        # index, validators from the interned record table. A round-trip
+        # byte-compare guards bit-exactness; any mismatch (e.g. a
+        # checkpoint-synced node whose window predates the cold index)
+        # falls back to the legacy full snapshot.
+        from . import freezer
+
+        for rp_root in restore_points:
+            full = self.get_state(rp_root)
+            freezer.put_restore_point(self.kv, self.types, rp_root, full)
+            loaded = freezer.load_restore_point(
+                self.kv, self.types, rp_root,
+                self.cold_block_root_at_slot, self._cold_state_root_at_slot,
+            )
+            if loaded is None or type(full).encode(full) != type(loaded).encode(loaded):
+                self.kv.delete(Column.COLD_PARTIAL, rp_root)
+                self._put_state_full(Column.COLD_STATE, rp_root, full)
 
         # The finalized state itself anchors the hot DB: keep it as a full
         # snapshot, drop frozen summaries/snapshots strictly below it.
@@ -287,6 +340,8 @@ class HotColdDB:
                 if slot < new_split:
                     self.kv.delete(col, key)
         self._set_split_slot(new_split)
+        _timer.__exit__(None, None, None)
+        _DB_SIZE.set(self.kv.approx_size())
 
     def cold_block_root_at_slot(self, slot: int) -> Optional[bytes]:
         return self.kv.get(Column.COLD_BLOCK_ROOTS, struct.pack("<Q", slot))

@@ -25,6 +25,10 @@ class Column:
     COLD_BLOCK_ROOTS = "cbr"
     COLD_STATE_ROOTS = "csr"
     COLD_STATE_SLOTS = "csl"  # state root -> slot (freezer reverse index)
+    COLD_PARTIAL = "cpt"      # chunked restore points (freezer.py)
+    COLD_VREC = "cvr"         # interned validator records (id -> SSZ)
+    COLD_VREC_INDEX = "cvi"   # validator record hash -> id
+    COLD_RANDAO = "crn"       # epoch -> final randao mix
     PUBKEY_CACHE = "pkc"
     METADATA = "meta"
     FORK_CHOICE = "frk"
@@ -52,6 +56,11 @@ class KeyValueStore:
 
     def iter_column(self, column: str) -> Iterator[tuple[bytes, bytes]]:
         raise NotImplementedError
+
+    def approx_size(self) -> int:
+        """Approximate on-disk bytes (0 when unknown) — feeds the
+        store_db_size_bytes gauge (reference exposes LevelDB sizes)."""
+        return 0
 
     def close(self) -> None:
         pass
@@ -85,12 +94,21 @@ class MemoryStore(KeyValueStore):
         with self._lock:
             return iter(sorted(self._data.get(column, {}).items()))
 
+    def approx_size(self) -> int:
+        with self._lock:
+            return sum(
+                len(k) + len(v)
+                for col in self._data.values()
+                for k, v in col.items()
+            )
+
 
 class SqliteStore(KeyValueStore):
     """Disk store over sqlite3 (native C). One table, (col, key) PK, WAL
     mode for concurrent readers. Atomic put_batch via a transaction."""
 
     def __init__(self, path: str):
+        self._path = path
         self._conn = sqlite3.connect(path, check_same_thread=False)
         self._lock = threading.Lock()
         with self._conn:
@@ -135,6 +153,14 @@ class SqliteStore(KeyValueStore):
                 "SELECT key, value FROM kv WHERE col=? ORDER BY key", (column,)
             ).fetchall()
         return iter((r[0], r[1]) for r in rows)
+
+    def approx_size(self) -> int:
+        import os
+
+        try:
+            return os.path.getsize(self._path)
+        except OSError:
+            return 0
 
     def close(self) -> None:
         self._conn.close()

@@ -1,0 +1,73 @@
+"""Structured logging with rate limiting (reference:
+``common/logging/src/lib.rs`` — slog decorators + ``TimeLatch`` at
+``:196`` suppressing repeat warnings inside a window).
+
+``log(level, msg, **fields)`` emits one ``key=value``-structured line to
+stderr; hot paths guard repeated messages with a :class:`TimeLatch` so a
+flood (e.g. queue shedding, repeated peer bans) costs one line per
+window instead of one per event."""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+
+from . import metrics
+
+_LINES = metrics.counter("log_lines_total", "structured log lines emitted")
+_SUPPRESSED = metrics.counter(
+    "log_lines_suppressed_total", "log lines dropped by TimeLatch windows"
+)
+
+LEVELS = ("debug", "info", "warn", "error", "crit")
+_MIN_LEVEL = "info"
+
+
+def set_level(level: str) -> None:
+    global _MIN_LEVEL
+    assert level in LEVELS
+    _MIN_LEVEL = level
+
+
+def log(level: str, msg: str, **fields) -> None:
+    if LEVELS.index(level) < LEVELS.index(_MIN_LEVEL):
+        return
+    _LINES.inc()
+    ts = time.strftime("%b %d %H:%M:%S")
+    kv = " ".join(f"{k}={_fmt(v)}" for k, v in fields.items())
+    print(f"{ts} {level.upper():5s} {msg}{' ' + kv if kv else ''}",
+          file=sys.stderr, flush=True)
+
+
+def _fmt(v) -> str:
+    if isinstance(v, bytes):
+        return "0x" + v.hex()[:16]
+    if isinstance(v, float):
+        return f"{v:.3f}"
+    return str(v)
+
+
+class TimeLatch:
+    """One ``fire()`` per ``window`` seconds (reference TimeLatch):
+    returns True when the caller should emit, False (counted) otherwise."""
+
+    def __init__(self, window: float = 30.0):
+        self.window = window
+        self._last = 0.0
+        self._lock = threading.Lock()
+
+    def fire(self) -> bool:
+        now = time.monotonic()
+        with self._lock:
+            if now - self._last >= self.window:
+                self._last = now
+                return True
+        _SUPPRESSED.inc()
+        return False
+
+
+def rate_limited(latch: TimeLatch, level: str, msg: str, **fields) -> None:
+    """Emit through a latch; suppressed lines are counted, not printed."""
+    if latch.fire():
+        log(level, msg, **fields)

@@ -1,0 +1,162 @@
+"""Round-5 Beacon API route-gap closure (VERDICT r4 item #4): headers
+list, blocks/{id}/root, blocks/{id}/attestations,
+states/{id}/validators/{validator_id}, deposit_snapshot,
+debug/beacon/heads, node/peers/{peer_id}, phase0 attestation rewards.
+Reference surface: ``beacon_node/http_api/src/lib.rs:483+``."""
+
+import copy
+import json
+import urllib.request
+
+import pytest
+
+from lighthouse_tpu.beacon_chain import BeaconChain
+from lighthouse_tpu.crypto import backend
+from lighthouse_tpu.http_api import BeaconApiServer
+from lighthouse_tpu.operation_pool import OperationPool
+from lighthouse_tpu.ssz import hash_tree_root
+from lighthouse_tpu.state_transition import store_replayer
+from lighthouse_tpu.store import HotColdDB, MemoryStore
+from lighthouse_tpu.testing.harness import StateHarness
+from lighthouse_tpu.types.chain_spec import minimal_spec
+from lighthouse_tpu.types.preset import MINIMAL
+from lighthouse_tpu.utils.slot_clock import ManualSlotClock
+
+
+@pytest.fixture(autouse=True)
+def fake_backend():
+    backend.set_backend("fake")
+    yield
+    backend.set_backend("cpu")
+
+
+@pytest.fixture
+def node():
+    h = StateHarness(
+        MINIMAL, minimal_spec(), validator_count=8,
+        fork_name="phase0", fake_sign=True,
+    )
+    genesis = copy.deepcopy(h.state)
+    db = HotColdDB(MemoryStore(), h.t, h.spec, store_replayer(h.preset, h.spec))
+    clock = ManualSlotClock(genesis.genesis_time, h.spec.seconds_per_slot)
+    chain = BeaconChain(h.preset, h.spec, h.t, db, genesis, slot_clock=clock)
+    chain.op_pool = OperationPool(h.preset, h.spec, h.t)
+    # two epochs of chain with attestations so rewards are defined
+    P = h.preset
+    for _ in range(2 * P.SLOTS_PER_EPOCH + 1):
+        slot = h.state.slot + 1
+        clock.set_slot(slot)
+        chain.on_tick(slot)
+        atts = (
+            h.attestations_for_slot(h.state, slot - 1)[: P.MAX_ATTESTATIONS]
+            if slot >= 2 else []
+        )
+        sb = h.produce_block(slot, attestations=atts)
+        h.process_block(sb, strategy="none")
+        chain.process_block(chain.verify_block_for_gossip(sb))
+    server = BeaconApiServer(chain, port=0).start()
+    yield h, chain, clock, server
+    server.stop()
+
+
+def _get(server, path, params=None):
+    url = f"http://127.0.0.1:{server.port}{path}"
+    if params:
+        url += "?" + "&".join(f"{k}={v}" for k, v in params.items())
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def _get_status(server, path):
+    url = f"http://127.0.0.1:{server.port}{path}"
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.status
+    except urllib.error.HTTPError as e:
+        return e.code
+
+
+def test_headers_list_and_filters(node):
+    h, chain, clock, server = node
+    out = _get(server, "/eth/v1/beacon/headers")["data"]
+    assert len(out) == 1
+    assert out[0]["root"] == "0x" + chain.head_block_root.hex()
+    assert out[0]["canonical"] is True
+
+    slot = int(out[0]["header"]["message"]["slot"])
+    by_slot = _get(server, "/eth/v1/beacon/headers", {"slot": slot})["data"]
+    assert any(e["root"] == out[0]["root"] for e in by_slot)
+
+    parent = out[0]["header"]["message"]["parent_root"]
+    by_parent = _get(
+        server, "/eth/v1/beacon/headers", {"parent_root": parent}
+    )["data"]
+    assert [e["root"] for e in by_parent] == [out[0]["root"]]
+
+
+def test_block_root_and_attestations(node):
+    h, chain, clock, server = node
+    root = _get(server, "/eth/v1/beacon/blocks/head/root")["data"]["root"]
+    assert root == "0x" + chain.head_block_root.hex()
+    atts = _get(server, "/eth/v1/beacon/blocks/head/attestations")
+    block = chain.store.get_block(chain.head_block_root)
+    assert len(atts["data"]) == len(block.message.body.attestations)
+
+
+def test_single_validator_lookup(node):
+    h, chain, clock, server = node
+    v0 = _get(server, "/eth/v1/beacon/states/head/validators/0")["data"]
+    assert v0["index"] == "0"
+    pk = v0["validator"]["pubkey"]
+    by_pk = _get(server, f"/eth/v1/beacon/states/head/validators/{pk}")["data"]
+    assert by_pk["index"] == "0"
+    assert _get_status(server, "/eth/v1/beacon/states/head/validators/9999") == 404
+    assert _get_status(server, "/eth/v1/beacon/states/head/validators/zz") == 400
+
+
+def test_debug_heads(node):
+    h, chain, clock, server = node
+    heads = _get(server, "/eth/v1/debug/beacon/heads")["data"]
+    assert len(heads) == 1
+    assert heads[0]["root"] == "0x" + chain.head_block_root.hex()
+
+
+def test_phase0_attestation_rewards(node):
+    h, chain, clock, server = node
+    P = h.preset
+    epoch = chain.head_state.slot // P.SLOTS_PER_EPOCH - 1
+    url = f"http://127.0.0.1:{server.port}/eth/v1/beacon/rewards/attestations/{epoch}"
+    req = urllib.request.Request(url, data=b"[]", method="POST")
+    with urllib.request.urlopen(req, timeout=10) as r:
+        out = json.loads(r.read())["data"]
+    assert out["total_rewards"], "eligible validators must appear"
+    # full participation in the harness: source/target rewards positive
+    row0 = out["total_rewards"][0]
+    assert int(row0["source"]) > 0
+    assert int(row0["target"]) > 0
+    assert out["ideal_rewards"]
+
+
+def test_deposit_snapshot_and_peer_by_id(node):
+    h, chain, clock, server = node
+    # no eth1 service attached -> 404, not 500
+    assert _get_status(server, "/eth/v1/beacon/deposit_snapshot") == 404
+    # attach a mock eth1 service and re-query
+    from lighthouse_tpu.eth1.service import Eth1Service, MockEth1Endpoint
+
+    ep = MockEth1Endpoint()
+    ep.add_deposit(b"\x01" * 48, b"\x02" * 32, 32_000_000_000, b"\x03" * 96, 1)
+    ep.add_deposit(b"\x04" * 48, b"\x05" * 32, 32_000_000_000, b"\x06" * 96, 1)
+    ep.add_deposit(b"\x07" * 48, b"\x08" * 32, 32_000_000_000, b"\x09" * 96, 1)
+    ep.seal_block(1, 1000)
+    svc = Eth1Service(ep, h.preset, h.spec)
+    svc.update()
+    chain.eth1 = svc
+    snap = _get(server, "/eth/v1/beacon/deposit_snapshot")["data"]
+    assert snap["deposit_count"] == "3"
+    # 3 = 0b11: two complete left subtrees
+    assert len(snap["finalized"]) == 2
+    assert snap["deposit_root"].startswith("0x")
+
+    # unknown peer id -> 404
+    assert _get_status(server, "/eth/v1/node/peers/deadbeef") == 404

@@ -114,6 +114,41 @@ def _wrong_subgroup(rng: random.Random):
     return (sig, pks, m), False
 
 
+def _corrupt_flag_bits(rng: random.Random):
+    """Corrupted-flag-bit corpus (VERDICT r4 #9): flip the compression /
+    infinity / sign flags of a VALID signature's top byte. Every variant
+    must be rejected identically by all backends — either at deserialize
+    (encoding rules) or at verification (wrong sign => wrong point)."""
+    (sig, pks, m), _ = _valid_set(rng)
+    raw = bytearray(sig.serialize())
+    choice = rng.randrange(3)
+    if choice == 0:
+        raw[0] &= 0x7F           # clear c_flag: uncompressed-length lie
+    elif choice == 1:
+        raw[0] |= 0x40           # set b_flag: infinity with nonzero body
+    else:
+        raw[0] ^= 0x20           # flip a_flag: wrong y sign
+    try:
+        bad = bls.Signature.deserialize(bytes(raw))
+    except bls.BlsError:
+        return _valid_set(rng)[0], True  # rejected at parse on all backends
+    return (bad, pks, m), False
+
+
+def _corrupt_pubkey(rng: random.Random):
+    """Bit-flip inside a pubkey's compressed body: the set must fail
+    (different point) or the encoding must be rejected at parse."""
+    (sig, pks, m), _ = _valid_set(rng)
+    raw = bytearray(pks[0].compress())
+    raw[rng.randrange(4, 48)] ^= 1 << rng.randrange(8)
+    from lighthouse_tpu.crypto import bls as _bls
+    try:
+        bad_pk = _bls.PublicKey.deserialize(bytes(raw)).point
+    except _bls.BlsError:
+        return _valid_set(rng)[0], True  # rejected at parse everywhere
+    return (sig, [bad_pk] + pks[1:], m), False
+
+
 GENERATORS = (
     _valid_set,
     _valid_set,
@@ -122,6 +157,8 @@ GENERATORS = (
     _wrong_message,
     _off_curve_x,
     _wrong_subgroup,
+    _corrupt_flag_bits,
+    _corrupt_pubkey,
 )
 
 

@@ -1,0 +1,110 @@
+"""Sync block-lookup service (VERDICT r4 item #6; reference
+``network/src/sync/block_lookups``): a node that receives a tip block
+whose ancestors it never saw must actively fetch the parent chain by
+root and import it — range sync alone would not help (it is driven by
+STATUS exchanges, not by orphan gossip)."""
+
+import time
+
+import pytest
+
+from lighthouse_tpu.crypto import backend
+from lighthouse_tpu.testing.simulator import LocalNetwork, LocalNode
+
+
+@pytest.fixture(autouse=True)
+def fake_backend():
+    backend.set_backend("fake")
+    yield
+    backend.set_backend("cpu")
+
+
+def test_lookup_fetches_unknown_parent_chain():
+    net = LocalNetwork(1, validator_count=8)
+    try:
+        # node 0 builds 3 slots of history alone
+        for _ in range(3):
+            net.tick_slot(attest=False)
+        a = net.nodes[0]
+        tip_root = a.chain.head_block_root
+        tip = a.chain.store.get_block(tip_root)
+        assert tip is not None and tip.message.slot == 3
+
+        # a fresh node joins with range sync DISABLED: only the lookup
+        # path may recover the ancestry
+        b = LocalNode(net.h, net.genesis, net.clock)
+        net.nodes.append(b)  # so net.close() tears it down
+        b.net.sync.trigger = lambda: None
+        assert b.net.connect("127.0.0.1", a.net.port) is not None
+        b.chain.on_tick(3)
+
+        # deliver ONLY the tip over gossip: parent chain is unknown
+        deadline = time.time() + 5
+        while time.time() < deadline and not b.net.transport.peers:
+            time.sleep(0.05)
+        peer = b.net.transport.peers[0]
+        b.net._on_gossip(
+            peer, b.net.topics.block(), type(tip).encode(tip)
+        )
+
+        deadline = time.time() + 20
+        while time.time() < deadline and b.chain.head_block_root != tip_root:
+            time.sleep(0.1)
+        assert b.chain.head_block_root == tip_root
+        # the whole ancestry was imported, not just the tip
+        cur = tip
+        while cur.message.slot > 0:
+            parent = b.chain.store.get_block(bytes(cur.message.parent_root))
+            assert parent is not None
+            cur = parent
+    finally:
+        net.close()
+
+
+def test_lookup_survives_bad_first_peer():
+    """The lookup retries across peers: a peer that answers by-root
+    requests with garbage gets downscored and the next peer serves."""
+    net = LocalNetwork(1, validator_count=8)
+    try:
+        for _ in range(2):
+            net.tick_slot(attest=False)
+        a = net.nodes[0]
+        tip_root = a.chain.head_block_root
+        tip = a.chain.store.get_block(tip_root)
+
+        b = LocalNode(net.h, net.genesis, net.clock)
+        net.nodes.append(b)
+        b.net.sync.trigger = lambda: None
+        assert b.net.connect("127.0.0.1", a.net.port) is not None
+        b.chain.on_tick(2)
+
+        deadline = time.time() + 5
+        while time.time() < deadline and not b.net.transport.peers:
+            time.sleep(0.05)
+
+        # sabotage: make the FIRST request attempt hit a liar by patching
+        # the peer ordering to include a garbage responder
+        real_best = b.net.lookups._best_peers
+
+        class Liar:
+            closed = False
+            addr = ("127.0.0.1", 0)
+            node_id = "liar"
+
+            def request(self, proto, payload, timeout=10):
+                return b"\x04\x00\x00\x00junk"
+
+        liar = Liar()
+        b.net.lookups._best_peers = lambda: [liar] + real_best()
+
+        b.net._on_gossip(
+            b.net.transport.peers[0], b.net.topics.block(), type(tip).encode(tip)
+        )
+        deadline = time.time() + 20
+        while time.time() < deadline and b.chain.head_block_root != tip_root:
+            time.sleep(0.1)
+        assert b.chain.head_block_root == tip_root
+        # the liar was penalized
+        assert b.net.peer_manager.score(liar) < 0
+    finally:
+        net.close()

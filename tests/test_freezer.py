@@ -1,0 +1,105 @@
+"""Chunked freezer restore points (VERDICT r4 item #5; reference
+``beacon_node/store/src/chunked_vector.rs`` + ``partial_beacon_state.rs``):
+restore points store interned validator ids + packed balances + a partial
+state, with vector fields reconstructed from the global per-slot/epoch
+cold columns — and must round-trip bit-exactly WITHOUT the legacy
+full-snapshot fallback."""
+
+import copy
+
+import pytest
+
+from lighthouse_tpu.ssz import hash_tree_root
+from lighthouse_tpu.state_transition import store_replayer
+from lighthouse_tpu.store import Column, HotColdDB, MemoryStore
+from lighthouse_tpu.testing.harness import StateHarness
+from lighthouse_tpu.types.chain_spec import minimal_spec
+from lighthouse_tpu.types.preset import MINIMAL
+
+
+@pytest.fixture(scope="module")
+def chain():
+    h = StateHarness(
+        MINIMAL, minimal_spec(), validator_count=8,
+        fork_name="phase0", fake_sign=True,
+    )
+    genesis = copy.deepcopy(h.state)
+    records = []
+    for _ in range(12):
+        sb = h.extend_chain(1, strategy="none", attest=False)[0]
+        state = copy.deepcopy(h.state)
+        records.append(
+            (hash_tree_root(sb.message), sb, hash_tree_root(state), state)
+        )
+    return h, genesis, records
+
+
+def _migrated_db(chain, kv):
+    h, genesis, records = chain
+    db = HotColdDB(
+        kv, h.t, h.spec, store_replayer(h.preset, h.spec),
+        slots_per_snapshot=4, slots_per_restore_point=4,
+    )
+    db.put_state_snapshot(hash_tree_root(genesis), genesis)
+    for root, sb, sroot, state in records:
+        db.put_block(root, sb)
+        db.put_state(sroot, state)
+    _, _, sroot_fin, state_fin = records[-2]
+    db.migrate(sroot_fin, state_fin)
+    return db
+
+
+def test_restore_points_are_chunked_not_full(chain):
+    kv = MemoryStore()
+    db = _migrated_db(chain, kv)
+    partials = list(kv.keys(Column.COLD_PARTIAL))
+    assert partials, "migration must produce chunked restore points"
+    # the byte-compare guard never fell back to legacy full snapshots
+    assert list(kv.keys(Column.COLD_STATE)) == []
+    # the interned validator-record table exists and is shared: far fewer
+    # records than validators x restore points
+    n_recs = len(list(kv.keys(Column.COLD_VREC)))
+    assert 0 < n_recs <= 8 + 4  # 8 validators, few changed records
+
+
+def test_chunked_restore_point_roundtrips_bit_exact(chain):
+    h, genesis, records = chain
+    kv = MemoryStore()
+    db = _migrated_db(chain, kv)
+    from lighthouse_tpu.store import freezer
+
+    for root_key in kv.keys(Column.COLD_PARTIAL):
+        loaded = freezer.load_restore_point(
+            kv, h.t, root_key,
+            db.cold_block_root_at_slot, db._cold_state_root_at_slot,
+        )
+        assert loaded is not None
+        assert hash_tree_root(loaded) == root_key
+
+
+def test_chunked_is_smaller_than_full_ssz(chain):
+    h, genesis, records = chain
+    kv = MemoryStore()
+    db = _migrated_db(chain, kv)
+    from lighthouse_tpu.store import freezer
+
+    for root_key in kv.keys(Column.COLD_PARTIAL):
+        blob = kv.get(Column.COLD_PARTIAL, root_key)
+        loaded = freezer.load_restore_point(
+            kv, h.t, root_key,
+            db.cold_block_root_at_slot, db._cold_state_root_at_slot,
+        )
+        full = len(type(loaded).encode(loaded))
+        # even at 8 validators the zeroed vectors compress the partial;
+        # at scale the interned registry dominates (benches/bench_freezer)
+        assert len(blob) < full
+
+
+def test_frozen_non_restore_states_still_replay(chain):
+    h, genesis, records = chain
+    kv = MemoryStore()
+    db = _migrated_db(chain, kv)
+    for _, _, sroot, state in records[:-2]:
+        loaded = db.get_state(sroot)
+        assert loaded is not None
+        assert hash_tree_root(loaded) == sroot

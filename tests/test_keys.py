@@ -33,6 +33,38 @@ EIP2333_CHILD_SK = (
 )
 
 
+# EIP-2333 published test cases 1-3 (external anchors, VERDICT r4 #9):
+# a 77-digit integer cannot match a re-derivation by accident, so these
+# independently certify HKDF_mod_r + lamport derivation end-to-end.
+EIP2333_MORE_VECTORS = [
+    (  # test case 1 ("pi" seed)
+        "3141592653589793238462643383279502884197169399375105820974944592",
+        29757020647961307431480504535336562678282505419141012933316116377660817309383,
+        3141592653,
+        25457201688850691947727629385191704516744796114925897962676248250929345014287,
+    ),
+    (  # test case 2
+        "0099FF991111002299DD7744EE3355BBDD8844115566CC55663355668888CC00",
+        27580842291869792442942448775674722299803720648445448686099262467207037398656,
+        4294967295,
+        29358610794459428860402234341874281240803786294062035874021252734817515685787,
+    ),
+    (  # test case 3
+        "d4e56740f876aef8c010b86a40d5f56745a118d0906a34e69aec8c0db1cb8fa3",
+        19022158461524446591288038168518313374041767046816487870552872741050760015818,
+        42,
+        31372231650479070279774297061823572166496564838472787488249775572789064611981,
+    ),
+]
+
+
+def test_eip2333_vectors_1_to_3():
+    for seed_hex, master_sk, index, child_sk in EIP2333_MORE_VECTORS:
+        master = derive_master_sk(bytes.fromhex(seed_hex))
+        assert master == master_sk
+        assert derive_child_sk(master, index) == child_sk
+
+
 def test_eip2333_vector_0():
     master = derive_master_sk(EIP2333_SEED)
     assert master == EIP2333_MASTER_SK

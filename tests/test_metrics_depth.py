@@ -1,0 +1,107 @@
+"""Metrics depth pass (VERDICT r4 item #10): store, sync, op-pool and
+slasher families must appear in the Prometheus exposition after their
+subsystems run, plus the rate-limited structured logger. Reference
+discipline: ``beacon_node/beacon_chain/src/metrics.rs`` (per-subsystem
+families) + ``common/logging/src/lib.rs:196`` (TimeLatch)."""
+
+import copy
+
+import pytest
+
+from lighthouse_tpu.crypto import backend
+from lighthouse_tpu.ssz import hash_tree_root
+from lighthouse_tpu.state_transition import store_replayer
+from lighthouse_tpu.store import HotColdDB, MemoryStore
+from lighthouse_tpu.testing.harness import StateHarness
+from lighthouse_tpu.types.chain_spec import minimal_spec
+from lighthouse_tpu.types.preset import MINIMAL
+from lighthouse_tpu.utils import logging as tlog
+from lighthouse_tpu.utils import metrics
+
+
+@pytest.fixture(autouse=True)
+def fake_backend():
+    backend.set_backend("fake")
+    yield
+    backend.set_backend("cpu")
+
+
+def test_store_families_present_after_use():
+    h = StateHarness(MINIMAL, minimal_spec(), validator_count=8,
+                     fork_name="phase0", fake_sign=True)
+    genesis = copy.deepcopy(h.state)
+    db = HotColdDB(MemoryStore(), h.t, h.spec, store_replayer(h.preset, h.spec),
+                   slots_per_snapshot=4, slots_per_restore_point=4)
+    db.put_state_snapshot(hash_tree_root(genesis), genesis)
+    roots = []
+    for _ in range(8):
+        sb = h.extend_chain(1, strategy="none", attest=False)[0]
+        state = copy.deepcopy(h.state)
+        sroot = hash_tree_root(state)
+        db.put_block(hash_tree_root(sb.message), sb)
+        db.put_state(sroot, state)
+        roots.append((sroot, state))
+    for sroot, _ in roots:
+        db.get_state(sroot)
+    db.migrate(*[(r, s) for r, s in roots[-2:]][0])
+    out = metrics.gather()
+    for family in (
+        "store_state_read_seconds", "store_state_replays_total",
+        "store_block_reads_total", "store_migrate_seconds",
+        "store_db_size_bytes",
+    ):
+        assert family in out, family
+    # the DB size gauge reflects the MemoryStore contents
+    assert metrics.gauge("store_db_size_bytes").value > 0
+
+
+def test_op_pool_and_slasher_families():
+    h = StateHarness(MINIMAL, minimal_spec(), validator_count=8,
+                     fork_name="phase0", fake_sign=True)
+    from lighthouse_tpu.operation_pool import OperationPool
+
+    pool = OperationPool(h.preset, h.spec, h.t)
+    h.extend_chain(2, strategy="none", attest=True)
+    for att in h.attestations_for_slot(h.state, h.state.slot):
+        pool.insert_attestation(att)
+        break
+    out = metrics.gather()
+    for family in (
+        "op_pool_attestations", "op_pool_voluntary_exits",
+        "op_pool_attester_slashings", "op_pool_proposer_slashings",
+    ):
+        assert family in out, family
+    assert metrics.gauge("op_pool_attestations").value >= 1
+
+    from lighthouse_tpu.slasher import Slasher
+
+    sl = Slasher(h.preset, h.spec, h.t)
+    sl.process_queued()
+    out = metrics.gather()
+    assert "slasher_batch_seconds" in out
+    assert "slasher_slashings_found_total" in out
+
+
+def test_sync_families_registered():
+    # registration happens at import; presence in the exposition is the
+    # contract the dashboards depend on
+    import lighthouse_tpu.network.service  # noqa: F401
+
+    out = metrics.gather()
+    for family in (
+        "sync_range_batches_total", "sync_range_blocks_total",
+        "sync_backfill_blocks_total", "sync_block_lookups_total",
+    ):
+        assert family in out, family
+
+
+def test_time_latch_rate_limits(capsys):
+    latch = tlog.TimeLatch(window=60.0)
+    before = metrics.counter("log_lines_suppressed_total").value
+    tlog.rate_limited(latch, "warn", "flood message", n=1)
+    for _ in range(5):
+        tlog.rate_limited(latch, "warn", "flood message", n=1)
+    after = metrics.counter("log_lines_suppressed_total").value
+    assert after - before == 5
+    err = capsys.readouterr().err
+    assert err.count("flood message") == 1

@@ -76,10 +76,10 @@ def test_rpc_rate_limit_and_gossip_flood():
 
 def test_invalid_gossip_peer_gets_banned_in_simulator():
     """An attacker transport floods node 0 with undecodable blocks: the
-    node disconnects it; on reconnect the decayed score resumes (address
-    identity) and the second flood crosses the ban threshold, after which
-    new connections from the attacker host are refused. The honest mesh
-    stays up throughout."""
+    node disconnects it; on reconnect the decayed score resumes under the
+    attacker's NOISE IDENTITY (same static key) and repeat offending
+    crosses the ban threshold, after which new connections from that
+    identity are refused. The honest mesh stays up throughout."""
     from lighthouse_tpu.network.transport import KIND_GOSSIP
 
     net = LocalNetwork(2, validator_count=8)
@@ -107,15 +107,15 @@ def test_invalid_gossip_peer_gets_banned_in_simulator():
 
         pa1 = flood(b"\x01")
         assert pa1.closed  # disconnected
-        assert not victim.net.peer_manager.is_banned("127.0.0.1")
+        assert not victim.net.peer_manager.is_banned(attacker.node_id)
         # each reconnect resumes the decayed score under the address key;
         # repeat offending accumulates down to the ban threshold
         for round_no in range(2, 12):
-            if victim.net.peer_manager.is_banned("127.0.0.1"):
+            if victim.net.peer_manager.is_banned(attacker.node_id):
                 break
             pa = flood(bytes([round_no]))
             assert pa.closed
-        assert victim.net.peer_manager.is_banned("127.0.0.1")
+        assert victim.net.peer_manager.is_banned(attacker.node_id)
         # a fresh connection from the banned host is refused: the victim
         # closes it on accept. EOF delivery to an idle reader can lag, so
         # probe with sends — a write after the remote FIN/RST surfaces

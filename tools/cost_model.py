@@ -1,0 +1,220 @@
+"""Analytic device cost model for the flagship BLS verification program
+(VERDICT r4 item #2): static multiply counts per signature set, bytes
+moved, and predicted sets/s/chip under explicit throughput assumptions.
+
+Counting unit: one **fp lane** = one 32-limb x 32-limb banded-Toeplitz
+product = 2016 int32 MACs (`crypto/device/fp.py` `mul`: 63 columns x 32
+limbs schoolbook; the reduction's fold contraction adds ~1024 MACs and
+the carry rounds ~300 adds — folded into the per-lane overhead factor).
+
+Every formula cites the code it models. Run:  python tools/cost_model.py
+(writes docs/COST_MODEL.md).
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from lighthouse_tpu.crypto.params import P, X  # noqa: E402
+
+# ---------------------------------------------------------------------------
+# Primitive lane counts (cite: crypto/device/{fp,fp2,curve,tower,pairing}.py)
+# ---------------------------------------------------------------------------
+
+MACS_PER_LANE = 2016          # fp.mul: 32x63 banded dot (fp.py NCOLS)
+LANE_OVERHEAD = 1.65          # fold contraction + carry rounds + adds, per lane
+
+FP2_MUL = 3                   # fp2.mul: Karatsuba, one 3-lane fp.mul
+FP2_SQ = 2                    # fp2.sq: (a0+a1)(a0-a1) | a0*a1
+G1_ADD = 12                   # curve.add (RCB complete) over fp
+G1_DBL = 8                    # curve.dbl over fp
+G2_ADD = 12 * FP2_MUL         # same formulas over fp2
+G2_DBL = 8 * FP2_MUL
+
+NBITS_P = (P - 2).bit_length()          # 381: fp.inv ladder length
+FP_INV = 2 * NBITS_P                    # sq + mul per bit (fp.pow_const scan)
+F2POW_PER_BIT = FP2_SQ + FP2_MUL        # htc.f2pow ladder step
+SQRT_ELEM = ((P - 3) // 4).bit_length() * F2POW_PER_BIT \
+    + ((P - 1) // 2).bit_length() * F2POW_PER_BIT + 6 * FP2_MUL
+# htc.sqrt: two f2pow ladders (a1, b) + candidate muls, per batch element
+
+SCALAR64_G2 = 64 * (G2_DBL + G2_ADD)    # curve.scalar_mul_bits, 64-bit
+SCALAR64_G1 = 64 * (G1_DBL + G1_ADD)
+
+X_BITS = (-X).bit_length()              # 64: Miller loop length (pairing.py)
+TOWER_SQ = 18 * FP2_MUL                 # tower.sq: 18 fp2 products
+TOWER_MUL = 27 * FP2_MUL                # tower.mul: 27 fp2 products
+LINE_MUL = 18 * FP2_MUL                 # pairing.mul_by_line
+DBL_STEP = 8 * FP2_MUL + 4 * FP2_SQ     # pairing._dbl_step (muls + squares)
+ADD_STEP = 10 * FP2_MUL + 2 * FP2_SQ    # pairing._add_line
+MILLER_PER_LANE = (X_BITS - 1) * (TOWER_SQ + LINE_MUL + DBL_STEP
+                                  + ADD_STEP + LINE_MUL)
+# per-bit body computes BOTH dbl and (selected) add legs — branch-free
+
+# final_exp_is_one (pairing.py): easy part + 16-entry table + multi-exp scan
+N_MULTIEXP = max(abs(l).bit_length() for l in [
+    (X - 1) ** 2 * (X**3 - X) + 3,
+    (X - 1) ** 2 * (X**2 - 1),
+    (X - 1) ** 2 * X,
+    (X - 1) ** 2,
+])
+TOWER_INV = 2 * (18 * FP2_MUL) + 15 * FP2_MUL + FP_INV + 10 * FP2_MUL
+EASY_PART = TOWER_INV + 2 * TOWER_MUL + 15
+FEXP_TABLE = 11 * TOWER_MUL + 4 * 15    # scan-built subset table + frobenii
+FEXP = EASY_PART + FEXP_TABLE + N_MULTIEXP * (TOWER_SQ + TOWER_MUL)
+
+# htc.map_to_g2 per unique message: 2 field elements x (SSWU pre ~10 fp2
+# + sqrt over 2 candidates) + isogeny Horner (4 polys x 3 steps) + adds +
+# clear_cofactor (2 x 64-bit G2 scalar mul + ~5 G2 adds + psi)
+HTC_PER_MSG = (
+    2 * (10 * FP2_MUL + 2 * SQRT_ELEM)
+    + 4 * 3 * FP2_MUL + 2 * FP2_MUL + FP_INV
+    + G2_ADD
+    + 2 * SCALAR64_G2 + 5 * G2_ADD + 3 * 2 * FP2_MUL + G2_DBL
+)
+DECOMPRESS_PER_SIG = SQRT_ELEM + 2 * FP2_MUL + 8  # _decompress_pre/post
+
+# to_affine: one field inv + 2 muls (amortized where noted)
+TO_AFFINE_G1 = FP_INV + 2
+TO_AFFINE_G2 = FP_INV + 4 + 2 * FP2_MUL
+
+
+def lanes_per_set(K: int, B: int, M: int) -> dict:
+    """Fp-mul lanes per signature set at bucket shape (B sets, K pubkey
+    slots, M unique messages). Batch-level costs amortize over B."""
+    per_set = {
+        "pubkey_aggregation (K G1 adds)": K * G1_ADD,
+        "subgroup + randomizer G2 scalar muls": 2 * SCALAR64_G2,
+        "randomizer G1 scalar mul": SCALAR64_G1,
+        "signature decompression": DECOMPRESS_PER_SIG,
+        "per-lane Miller loop": MILLER_PER_LANE,
+        "to_affine (pk, per set)": TO_AFFINE_G1,
+    }
+    amortized = {
+        "hash_to_curve (M msgs / B sets)": M * HTC_PER_MSG / B,
+        "final exponentiation / B": FEXP / B,
+        "G2 accumulator + to_affine / B": (B * G2_ADD + TO_AFFINE_G2) / B,
+    }
+    total = sum(per_set.values()) + sum(amortized.values())
+    return {"per_set": per_set, "amortized": amortized, "total": total}
+
+
+def bytes_per_set(K: int) -> int:
+    """HBM traffic per set for program INPUTS (int32 limb encodings,
+    fp.py layout): pubkeys K x 2 x 32 x 4B, sig x 2 x 32 x 4B, masks,
+    randomness. Intermediates are compiler-managed (VMEM-resident per
+    fusion) and excluded."""
+    return K * 2 * 32 * 4 + 2 * 32 * 4 + K + 8 + 1
+
+
+SCENARIOS = {
+    # label: (int32 MAC/s, assumption note)
+    "v5e VPU int32": (
+        2.0e12,
+        "VPU-bound: 8x128 lanes x ~2 int32 MAC/lane/cycle x ~0.94 GHz "
+        "(int32 multiplies do not hit the MXU natively)",
+    ),
+    "v5e MXU via 12-bit->int8 split": (
+        4.9e13,
+        "XLA lowers the int32 dot to 4 int8 MXU passes (12-bit limbs "
+        "split 8+4): 394 TOPS int8 / 4 passes / 2 (ops->MACs)",
+    ),
+}
+
+
+def main() -> None:
+    ks = [8, 16, 128, 512]
+    rows = []
+    for K in ks:
+        B, M = 256, 8
+        c = lanes_per_set(K, B, M)
+        total_lanes = c["total"]
+        total_macs = total_lanes * MACS_PER_LANE * LANE_OVERHEAD
+        row = {
+            "K": K,
+            "lanes": int(total_lanes),
+            "gmacs_per_set": total_macs / 1e9,
+            "bytes_in": bytes_per_set(K),
+        }
+        for label, (rate, _) in SCENARIOS.items():
+            row[label] = rate / total_macs
+        rows.append(row)
+
+    c16 = lanes_per_set(16, 256, 8)
+    lines = []
+    w = lines.append
+    w("# COST_MODEL.md — analytic device cost of the flagship BLS program")
+    w("")
+    w("Generated by `tools/cost_model.py` (re-run after kernel changes).")
+    w("Counting unit: one **fp lane** = one 32-limb banded-Toeplitz product")
+    w(f"= {MACS_PER_LANE} int32 MACs (`crypto/device/fp.py` NCOLS x NL);")
+    w(f"reduction overhead factor {LANE_OVERHEAD} covers the fold")
+    w("contraction + carry rounds. Reference workload being modelled:")
+    w("`/root/reference/consensus/state_processing/src/per_block_processing/"
+      "block_signature_verifier.rs:374-382`.")
+    w("")
+    w("## Where the multiplies are (K=16, B=256, M=8; fp lanes per set)")
+    w("")
+    w("| component | lanes/set |")
+    w("|---|---|")
+    for name, v in c16["per_set"].items():
+        w(f"| {name} | {int(v):,} |")
+    for name, v in c16["amortized"].items():
+        w(f"| {name} | {int(v):,} |")
+    w(f"| **total** | **{int(c16['total']):,}** |")
+    w("")
+    w("Derived constants: fp.inv ladder = "
+      f"{FP_INV} lanes ({NBITS_P}-bit Fermat scan); one Fp2 sqrt element = "
+      f"{SQRT_ELEM:,} lanes (two ~381-bit ladders); 64-bit G2 scalar mul = "
+      f"{SCALAR64_G2:,} lanes; Miller loop = {MILLER_PER_LANE:,} lanes/lane "
+      f"({X_BITS - 1} bits x (Fp12 sq + 2 sparse-line muls + dbl + add)); "
+      f"final exp = {FEXP:,} lanes once per batch "
+      f"({N_MULTIEXP}-step shared-squaring multi-exp).")
+    w("")
+    w("## Predicted sets/s/chip by committee-size bucket")
+    w("")
+    hdr = "| K | lanes/set | GMAC/set | " + " | ".join(SCENARIOS) + " |"
+    w(hdr)
+    w("|" + "---|" * (3 + len(SCENARIOS)))
+    for r in rows:
+        w(
+            f"| {r['K']} | {r['lanes']:,} | {r['gmacs_per_set']:.2f} | "
+            + " | ".join(f"{r[label]:,.0f} /s" for label in SCENARIOS)
+            + " |"
+        )
+    w("")
+    w("Assumptions:")
+    for label, (rate, note) in SCENARIOS.items():
+        w(f"- **{label}**: {rate:.1e} int32 MAC/s — {note}.")
+    w("")
+    w("## Reading the table")
+    w("")
+    w("- The 50k agg/s target (150k sets/s, BASELINE.json) needs ~"
+      f"{150e3 * rows[1]['gmacs_per_set'] / 1e3:.0f} int32 TMAC/s at K=16 — "
+      "only the MXU-decomposition scenario reaches that envelope; if XLA "
+      "keeps int32 dots on the VPU, the ceiling is the VPU row and the "
+      "kernel must move to an int8-decomposed Pallas matmul to go further.")
+    w("- Scalar-mul + Miller dominate (~2/3 of lanes). Both are scan-bound "
+      "with full-batch width, so they saturate whatever unit executes the "
+      "banded dot; bytes/set "
+      f"({bytes_per_set(16):,} B at K=16) against >GMAC/set arithmetic means "
+      "the program is compute-bound on any plausible HBM bandwidth.")
+    w("- Cross-check vs measured XLA:CPU (DP_SCALING.json): ~5 sets/s at "
+      f"K=16 implies ~{5 * rows[1]['gmacs_per_set']:.0f} int32 GMAC/s "
+      "achieved on one CPU core-ish — the right order for scalar int32 "
+      "code, which says the lane count above is the true work, not "
+      "padding waste.")
+    w("")
+    out = REPO / "docs" / "COST_MODEL.md"
+    out.write_text("\n".join(lines) + "\n")
+    print(f"wrote {out}")
+    for r in rows:
+        print(r)
+
+
+if __name__ == "__main__":
+    main()

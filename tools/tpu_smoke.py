@@ -1,0 +1,105 @@
+"""TPU smoke run: the flagship device program at a seconds-scale shape.
+
+Runs `verify_batch_raw_fn` (device decompression + hash-to-curve +
+aggregation + subgroup checks + multi-pairing; see crypto/device/bls.py)
+on the REAL TPU with a correct small workload, asserts the verdict, and
+records compile + step wall-clock. This is the auto-run-on-relay-revival
+payload (VERDICT r4 "do this" #1): a small shape that proves device
+execution end-to-end in minutes, independent of the full bench geometry.
+
+Usage: python tools/tpu_smoke.py [B K M n_agg committee] [--out FILE]
+Prints one JSON line and (with --out) writes it to FILE.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def main() -> None:
+    argv = sys.argv[1:]
+    out_file = None
+    if "--out" in argv:
+        i = argv.index("--out")
+        out_file = argv[i + 1]
+        del argv[i : i + 2]
+    args = [a for a in argv if not a.startswith("--")]
+    B, K, M = (int(a) for a in args[:3]) if len(args) >= 3 else (8, 8, 4)
+    n_agg = int(args[3]) if len(args) >= 4 else 2
+    committee = int(args[4]) if len(args) >= 5 else K
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+    import jax
+
+    try:
+        cache_dir = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"
+        )
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
+    dev = jax.devices()[0]
+    platform = dev.platform
+
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.crypto.params import R
+    from lighthouse_tpu.crypto.device.bls import (
+        pack_signature_sets_raw,
+        verify_batch_raw_fn,
+    )
+
+    # real workload: gossip-aggregate mix (2 single-pubkey + 1 committee set)
+    sks = [bls.SecretKey(1_000 + i) for i in range(committee)]
+    pks = [sk.public_key().point for sk in sks]
+    sk_agg = bls.SecretKey(sum(1_000 + i for i in range(committee)) % R)
+    msgs = [bytes([m + 1]) * 32 for m in range(min(M, 4))]
+    sets = []
+    for i in range(n_agg):
+        m = msgs[i % len(msgs)]
+        sets.append((bls.Signature.deserialize(sks[0].sign(m).serialize()), [pks[0]], m))
+        sets.append((bls.Signature.deserialize(sks[1].sign(m).serialize()), [pks[1]], m))
+        sets.append((bls.Signature.deserialize(sk_agg.sign(m).serialize()), pks, m))
+    sets = sets[:B]
+
+    packed = pack_signature_sets_raw(sets, pad_b=B, pad_k=K, pad_m=M)
+
+    t0 = time.perf_counter()
+    compiled = jax.jit(verify_batch_raw_fn).lower(*packed).compile()
+    compile_s = time.perf_counter() - t0
+
+    out = compiled(*packed)
+    jax.block_until_ready(out)
+    verdict = bool(out)
+
+    reps = 3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        jax.block_until_ready(compiled(*packed))
+    step_s = (time.perf_counter() - t0) / reps
+
+    rec = {
+        "program": "verify_batch_raw_fn",
+        "backend": platform,
+        "device": str(dev),
+        "shapes": {"B": B, "K": K, "M": M, "n_sets": len(sets)},
+        "compile_s": round(compile_s, 1),
+        "step_s": round(step_s, 4),
+        "sets_per_sec": round(B / step_s, 2),
+        "verified": verdict,
+    }
+    line = json.dumps(rec)
+    print(line)
+    if out_file:
+        with open(out_file, "w") as f:
+            f.write(line + "\n")
+    assert verdict, "smoke batch must verify"
+
+
+if __name__ == "__main__":
+    main()
