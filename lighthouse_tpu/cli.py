@@ -135,6 +135,22 @@ def build_parser() -> argparse.ArgumentParser:
     nt.add_argument("--validators", type=int, default=64)
     nt.add_argument("--genesis-time", type=int, default=0)
     nt.add_argument("--out-dir", dest="out_dir", required=True)
+    eg = lcli_sub.add_parser(
+        "eth1-genesis",
+        help="genesis from (mock) eth1 deposit-contract logs",
+    )
+    eg.add_argument("--preset", choices=["mainnet", "minimal"], default="minimal")
+    eg.add_argument("--validators", type=int, default=64)
+    eg.add_argument("--genesis-time", type=int, default=0)
+    eg.add_argument("--out", required=True)
+    bk = lcli_sub.add_parser(
+        "generate-bootnode-record",
+        help="write a bootnode identity + address record (ENR analogue)",
+    )
+    bk.add_argument("--seed", default=None, help="deterministic identity seed")
+    bk.add_argument("--host", default="127.0.0.1")
+    bk.add_argument("--port", type=int, default=9000)
+    bk.add_argument("--out", required=True)
 
     db = sub.add_parser("db", help="database manager")
     _add_global_flags(db)
@@ -429,6 +445,25 @@ def run_boot_node(args) -> int:
 
 
 def run_lcli(args) -> int:
+    if args.lcli_command == "generate-bootnode-record":
+        import json as _json
+
+        from .network import noise as _noise
+
+        ident = (
+            _noise.Identity.from_seed(args.seed.encode())
+            if args.seed else _noise.Identity()
+        )
+        rec = {
+            "node_id": ident.node_id,
+            "static_pubkey": "0x" + ident.public.hex(),
+            "host": args.host,
+            "port": args.port,
+        }
+        with open(args.out, "w") as f:
+            _json.dump(rec, f, indent=1)
+        print(f"bootnode record {ident.node_id[:16]}... -> {args.out}")
+        return 0
     from .ssz.json import to_json
     from .state_transition import interop_genesis_state, per_slot_processing, process_block
     from .state_transition.epoch import fork_of
@@ -510,6 +545,53 @@ def run_lcli(args) -> int:
         fork = spec.fork_name_at_epoch(slot // preset.SLOTS_PER_EPOCH)
         sb = t.signed_block[fork].decode(raw)
         print("0x" + _htr(type(sb.message), sb.message).hex())
+        return 0
+    if args.lcli_command == "eth1-genesis":
+        # reference lcli eth1-genesis: build genesis from deposit-contract
+        # logs; here the deposits are built locally with signed
+        # DepositData (the real-chain variant needs an eth1 RPC)
+        import hashlib as _hashlib
+
+        from .ssz.sha256 import hash32_concat as _h32
+        from .state_transition.genesis import (
+            initialize_beacon_state_from_eth1,
+            interop_secret_key,
+        )
+        from .types.chain_spec import DOMAIN_DEPOSIT
+        from .types.domains import compute_domain, compute_signing_root
+
+        deposits = []
+        domain = compute_domain(
+            spec, DOMAIN_DEPOSIT, spec.genesis_fork_version, bytes(32)
+        )
+        for i in range(args.validators):
+            sk = interop_secret_key(i)
+            pubkey = sk.public_key().serialize()
+            cred = b"\x00" + _hashlib.sha256(pubkey).digest()[1:]
+            msg = t.DepositMessage(
+                pubkey=pubkey, withdrawal_credentials=cred,
+                amount=preset.MAX_EFFECTIVE_BALANCE,
+            )
+            root = compute_signing_root(t.DepositMessage, msg, domain)
+            dd = t.DepositData(
+                pubkey=pubkey, withdrawal_credentials=cred,
+                amount=preset.MAX_EFFECTIVE_BALANCE,
+                signature=sk.sign(root).serialize(),
+            )
+            deposits.append(t.Deposit(data=dd))
+        # deterministic mock eth1 block hash (same rule the mock endpoint
+        # uses); initialize_* recomputes the incremental deposit root
+        # itself from `deposits`
+        eth1_hash = _h32((1).to_bytes(32, "little"), b"eth1".ljust(32, b"\x00"))
+        st = initialize_beacon_state_from_eth1(
+            preset, spec, eth1_hash, args.genesis_time or 1, deposits
+        )
+        write_state(args.out, st)
+        print(
+            f"wrote eth1 genesis ({len(st.validators)} validators, "
+            f"deposit_root 0x{bytes(st.eth1_data.deposit_root).hex()[:16]}...) "
+            f"to {args.out}"
+        )
         return 0
     if args.lcli_command == "new-testnet":
         import os as _os

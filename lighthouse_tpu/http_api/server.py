@@ -37,7 +37,14 @@ consume):
     GET  /eth/v1/beacon/rewards/blocks/{block_id}
     POST /eth/v1/beacon/rewards/attestations/{epoch}
     POST /eth/v1/validator/liveness/{epoch}
-    GET  /eth/v1/node/peer_count
+    GET  /eth/v1/node/peer_count | /eth/v1/node/peers/{peer_id}
+    GET  /eth/v1/beacon/headers (+ ?slot= / ?parent_root= filters)
+    GET  /eth/v1/beacon/blocks/{block_id}/root
+    GET  /eth/v1/beacon/blocks/{block_id}/attestations
+    GET  /eth/v1/beacon/states/{state_id}/validators/{validator_id}
+    GET  /eth/v1/beacon/deposit_snapshot
+    GET  /eth/v1/debug/beacon/heads
+    GET  /lighthouse/health
     GET  /metrics
 """
 
@@ -336,6 +343,12 @@ class BeaconApiServer:
             return {"data": entries}
         if path == "/metrics":
             return metrics.gather()
+        if path == "/lighthouse/health":
+            # node-local host stats (reference common/system_health via the
+            # lighthouse-specific API namespace)
+            from ..utils import system_health
+
+            return {"data": system_health.observe()}
 
 
         m = re.fullmatch(r"/eth/v1/beacon/states/([^/]+)/root", path)

@@ -1,14 +1,14 @@
-"""Pure-Python Snappy codec: raw block format + framing format.
+"""Snappy codec: raw block format + framing format.
 
 Used by the ef_tests harness (``.ssz_snappy`` vector files) and the
 networking layer's SSZ-snappy encodings (reference: gossip payloads use
 raw snappy blocks; req/resp streams use the framing format —
 ``lighthouse_network/src/rpc/codec/ssz_snappy.rs``).
 
-Decompression implements the full format. Compression emits spec-valid
-streams using literal elements only (correct, not size-optimal — fine for
-tests and local transport; swap in a native backend if profiling ever
-cares).
+The raw-block hot path (every gossip frame) prefers the NATIVE C codec
+(``_native/snappy.c`` — real hash-match compression, the algorithm the
+reference gets from the Rust ``snap`` crate); the pure-Python
+implementation remains as fallback and as the framing-format layer.
 """
 
 from __future__ import annotations
@@ -58,8 +58,61 @@ def _write_uvarint(n: int) -> bytes:
 # raw block format
 # ---------------------------------------------------------------------------
 
+def _native_lib():
+    global _NATIVE
+    if _NATIVE is _UNSET:
+        import ctypes
+
+        from .._native import build_and_load
+
+        lib = build_and_load("snappy")
+        if lib is not None:
+            lib.lt_snappy_max_compressed.restype = ctypes.c_size_t
+            lib.lt_snappy_max_compressed.argtypes = [ctypes.c_size_t]
+            lib.lt_snappy_compress.restype = ctypes.c_size_t
+            lib.lt_snappy_compress.argtypes = [
+                ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+            ]
+            lib.lt_snappy_uncompressed_length.restype = ctypes.c_long
+            lib.lt_snappy_uncompressed_length.argtypes = [
+                ctypes.c_char_p, ctypes.c_size_t,
+            ]
+            lib.lt_snappy_decompress.restype = ctypes.c_long
+            lib.lt_snappy_decompress.argtypes = [
+                ctypes.c_char_p, ctypes.c_size_t,
+                ctypes.c_char_p, ctypes.c_size_t,
+            ]
+        _NATIVE = lib
+    return _NATIVE
+
+
+_UNSET = object()
+_NATIVE = _UNSET
+
+
 def decompress_raw(data: bytes) -> bytes:
-    """Snappy raw (frame-less) block."""
+    """Snappy raw (frame-less) block (native fast path)."""
+    lib = _native_lib()
+    if lib is not None:
+        import ctypes
+
+        want = lib.lt_snappy_uncompressed_length(data, len(data))
+        # An attacker controls the length header: allocate only what a
+        # VALID stream of this size could produce (a 3-byte copy element
+        # emits <= 64 bytes, so expansion is < 64x + slack) — a 5-byte
+        # frame claiming 2 GiB must fail before any big allocation.
+        if want < 0 or want > 64 * len(data) + 64:
+            raise SnappyError("bad uncompressed length")
+        buf = ctypes.create_string_buffer(max(int(want), 1))
+        got = lib.lt_snappy_decompress(data, len(data), buf, want)
+        if got < 0:
+            raise SnappyError("malformed snappy block")
+        return ctypes.string_at(buf, got)
+    return _decompress_raw_py(data)
+
+
+def _decompress_raw_py(data: bytes) -> bytes:
+    """Snappy raw (frame-less) block, pure Python."""
     expected, pos = _read_uvarint(data, 0)
     out = bytearray()
     n = len(data)
@@ -113,6 +166,19 @@ def decompress_raw(data: bytes) -> bytes:
 
 
 def compress_raw(data: bytes) -> bytes:
+    """Raw block (native hash-match compression when available)."""
+    lib = _native_lib()
+    if lib is not None:
+        import ctypes
+
+        cap = lib.lt_snappy_max_compressed(len(data))
+        buf = ctypes.create_string_buffer(int(cap))
+        n = lib.lt_snappy_compress(data, len(data), buf)
+        return ctypes.string_at(buf, n)
+    return _compress_raw_py(data)
+
+
+def _compress_raw_py(data: bytes) -> bytes:
     """Literal-only raw block (valid per the format spec)."""
     out = bytearray(_write_uvarint(len(data)))
     pos = 0

@@ -142,3 +142,63 @@ def test_results_delivered_and_latency_recorded():
         assert sorted(results) == [i * 2 for i in range(10)]
     finally:
         bp.shutdown()
+
+
+def test_dual_latency_throughput_lanes():
+    """SURVEY hard-part #4 (dual small/large batch lanes): an idle queue
+    must hand a LONE attestation to the handler immediately (batch of 1 →
+    small padded device shape → low latency), while a burst coalesces to
+    the large ceiling (throughput lane). The lanes are emergent: greedy
+    drain + _round_up shape bucketing in the backend."""
+    import threading
+    import time
+
+    from lighthouse_tpu.beacon_processor import (
+        BeaconProcessor, Work, WorkKind,
+    )
+
+    batches = []
+    gate = threading.Event()
+
+    def handler(items):
+        batches.append(len(items))
+        gate.set()
+        return [None] * len(items)
+
+    bp = BeaconProcessor(
+        {WorkKind.GOSSIP_ATTESTATION: handler}, n_workers=1
+    )
+    try:
+        # latency lane: one item, no waiting for fill
+        t0 = time.monotonic()
+        bp.submit(Work(WorkKind.GOSSIP_ATTESTATION, "solo"))
+        assert gate.wait(2.0)
+        assert batches[0] == 1
+        assert time.monotonic() - t0 < 1.0
+
+        # throughput lane: a burst coalesces toward the 256 ceiling.
+        # Stall the single worker with a sentinel so the burst queues up
+        # behind it instead of racing the submission loop.
+        gate.clear()
+        release = threading.Event()
+        stall = threading.Event()
+
+        def slow_handler(items):
+            if items == ["stall"]:
+                stall.set()
+                release.wait(5)
+            batches.append(len(items))
+            return [None] * len(items)
+
+        bp.handlers[WorkKind.GOSSIP_ATTESTATION] = slow_handler
+        bp.submit(Work(WorkKind.GOSSIP_ATTESTATION, "stall"))
+        assert stall.wait(2.0)
+        for i in range(512):
+            bp.submit(Work(WorkKind.GOSSIP_ATTESTATION, i))
+        release.set()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and sum(batches) < 1 + 1 + 512:
+            time.sleep(0.02)
+        assert max(batches) == 256, batches  # ceiling reached
+    finally:
+        bp.shutdown()

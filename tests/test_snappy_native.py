@@ -1,0 +1,72 @@
+"""Native C snappy codec vs the pure-Python reference decoder/encoder
+(``_native/snappy.c`` — the wire codec of every gossip frame; reference
+uses the Rust ``snap`` crate in its ssz_snappy codecs). Differential:
+any valid stream must decode identically on both implementations."""
+
+import random
+
+import pytest
+
+from lighthouse_tpu.utils import snappy
+
+
+def _have_native():
+    return snappy._native_lib() is not None
+
+
+pytestmark = pytest.mark.skipif(
+    not _have_native(), reason="no C compiler for the native codec"
+)
+
+
+def _corpus():
+    rng = random.Random(42)
+    yield b""
+    yield b"x"
+    yield b"abcd" * 3
+    yield bytes(rng.randrange(256) for _ in range(70_000))   # incompressible
+    yield b"\x00" * 200_000                                   # RLE
+    yield (b"the quick brown fox " * 1000)[:13_337]           # text
+    # structured: SSZ-ish with repeated 32-byte roots
+    root = bytes(rng.randrange(256) for _ in range(32))
+    yield root * 500 + bytes(rng.randrange(256) for _ in range(100))
+
+
+def test_roundtrip_and_cross_decode():
+    for i, d in enumerate(_corpus()):
+        native_c = snappy.compress_raw(d)
+        py_c = snappy._compress_raw_py(d)
+        # native encode -> native + python decode
+        assert snappy.decompress_raw(native_c) == d, i
+        assert snappy._decompress_raw_py(native_c) == d, i
+        # python encode -> native decode
+        assert snappy.decompress_raw(py_c) == d, i
+
+
+def test_native_actually_compresses():
+    d = b"\x11\x22\x33\x44" * 10_000
+    assert len(snappy.compress_raw(d)) < len(d) // 10
+
+
+def test_malformed_streams_rejected():
+    good = snappy.compress_raw(b"hello world " * 100)
+    for mutation in (
+        good[:3],                       # truncated
+        good[:-5],                      # truncated tail
+        b"\xff" * 40,                   # garbage varint/oversized
+        bytes([good[0] + 1]) + good[1:],  # wrong length header
+    ):
+        with pytest.raises(snappy.SnappyError):
+            snappy.decompress_raw(mutation)
+
+
+def test_random_fuzz_roundtrip():
+    rng = random.Random(7)
+    for _ in range(200):
+        n = rng.randrange(0, 5000)
+        # mix of random and self-similar content exercises copy paths
+        base = bytes(rng.randrange(256) for _ in range(max(1, n // 7)))
+        d = (base * 8)[:n]
+        c = snappy.compress_raw(d)
+        assert snappy.decompress_raw(c) == d
+        assert snappy._decompress_raw_py(c) == d
