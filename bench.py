@@ -27,6 +27,11 @@ a SUBPROCESS with a deadline first; if the probe fails or times out the
 bench falls back to the CPU backend so a measurement is always printed.
 Persistent compilation cache keeps recurring runs cheap.
 
+Per-impl legs (VERDICT r5 rec #2): the headline bucket is re-measured
+under ``FP_IMPL=matmul_int8`` (the int8 limb-split MXU decomposition of
+``fp.mul``) after the toeplitz_int32 headline, and both land in
+``fp_impl_legs`` so rounds can track the contraction engines separately.
+
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 ``vs_baseline`` is the ratio of the measured device throughput to the
 NATIVE C CPU baseline (`_native/bls12381.c`, backend "cpu-native" — the
@@ -70,6 +75,17 @@ _T0 = time.perf_counter()
 
 def _budget_left() -> float:
     return BENCH_BUDGET_S - (time.perf_counter() - _T0)
+
+
+def _configure_jax_cache(jax) -> None:
+    """Persistent compile cache for every bench process (probe subprocess
+    carries its own textual copy inside its ``-c`` program)."""
+    try:
+        cache_dir = os.path.join(os.path.dirname(__file__) or ".", ".jax_cache")
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
 
 
 def _shrink_for_cpu_fallback() -> None:
@@ -244,12 +260,7 @@ def main() -> None:
         # (and hanging on a dead tunnel); the config knob does.
         jax.config.update("jax_platforms", "cpu")
 
-    try:
-        cache_dir = os.path.join(os.path.dirname(__file__) or ".", ".jax_cache")
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception:
-        pass
+    _configure_jax_cache(jax)
 
     from lighthouse_tpu.crypto.device.bls import (
         pack_signature_sets_raw,
@@ -282,6 +293,53 @@ def main() -> None:
     sets_per_sec = headline["sets_per_sec"]
     agg_per_sec = sets_per_sec / 3.0
 
+    # Per-implementation leg (VERDICT r5 rec #2): re-run the HEADLINE
+    # bucket under the OTHER fp.mul engine (crypto/device/fp.py) — by
+    # default matmul_int8, the int8-MXU decomposition. Runs LAST, in a
+    # SUBPROCESS with its own deadline: a second giant XLA compile in
+    # this process has segfaulted before (see dryrun_multichip), and a
+    # wedge there must not cost the already-measured headline line.
+    # Skipped-with-marker beats silent truncation.
+    from lighthouse_tpu.crypto.device import fp as device_fp
+
+    headline_impl = device_fp.get_impl()
+    alt_impl = (
+        device_fp.IMPL_MATMUL_INT8
+        if headline_impl != device_fp.IMPL_MATMUL_INT8
+        else device_fp.IMPL_TOEPLITZ_INT32
+    )
+    impl_legs = {headline_impl: headline}
+    leg_timeout = min(900.0, _budget_left() - 60)
+    if leg_timeout < 300:
+        impl_legs[alt_impl] = {"skipped": "budget"}
+    else:
+        env = dict(os.environ)
+        env["LIGHTHOUSE_TPU_FP_IMPL"] = alt_impl
+        if use_cpu:
+            env["JAX_PLATFORMS"] = "cpu"
+        try:
+            r = subprocess.run(
+                [sys.executable, __file__, "--impl-leg",
+                 str(N_AGG), str(COMMITTEE), str(N_MSGS),
+                 str(B_PAD), str(K_PAD), str(M_PAD)],
+                capture_output=True, text=True, timeout=leg_timeout,
+                env=env,
+            )
+            if r.returncode == 0:
+                impl_legs[alt_impl] = json.loads(
+                    r.stdout.strip().splitlines()[-1]
+                )
+            elif r.returncode == 3:
+                impl_legs[alt_impl] = {
+                    "error": f"backend init exceeded {INIT_TIMEOUT_S}s"
+                }
+            else:
+                impl_legs[alt_impl] = {"error": r.stderr[-200:]}
+        except subprocess.TimeoutExpired:
+            impl_legs[alt_impl] = {"skipped": f"timeout>{leg_timeout:.0f}s"}
+        except Exception as e:  # the alt leg must not kill the line
+            impl_legs[alt_impl] = {"error": str(e)[:200]}
+
     print(
         json.dumps(
             {
@@ -299,11 +357,58 @@ def main() -> None:
                 "reps": REPS,
                 "shapes": {"B": B_PAD, "K": K_PAD, "M": M_PAD,
                            "n_sets": headline["n_sets"]},
+                "fp_impl": headline_impl,
+                "fp_impl_legs": impl_legs,
                 "buckets": buckets,
             }
         )
     )
 
 
+def _impl_leg_main(argv) -> None:
+    """Subprocess body for the per-impl leg: measure ONE bucket under the
+    fp engine selected by LIGHTHOUSE_TPU_FP_IMPL (set by the parent) and
+    print its record as one JSON line. Isolated so its XLA compile cannot
+    wedge or crash the parent's already-measured headline."""
+    import threading
+
+    n_agg, committee, n_msgs, b, k, m = (int(v) for v in argv)
+
+    # Backend-init watchdog (mirrors the parent probe's INIT_TIMEOUT_S):
+    # on the real-TPU path the parent still holds its device client, and a
+    # dead/contended tunnel would otherwise hang this child for the whole
+    # leg timeout. Fail FAST with a distinct exit code instead.
+    watchdog = threading.Timer(INIT_TIMEOUT_S, lambda: os._exit(3))
+    watchdog.daemon = True
+    watchdog.start()
+    import jax
+
+    jax.devices()
+    watchdog.cancel()
+
+    _configure_jax_cache(jax)
+
+    from lighthouse_tpu.crypto.device import fp as device_fp
+    from lighthouse_tpu.crypto.device.bls import (
+        pack_signature_sets_raw,
+        verify_batch_raw_staged,
+    )
+
+    sets = build_sets(n_agg, committee, n_msgs)
+    rec = measure_bucket(
+        pack_signature_sets_raw, verify_batch_raw_staged, sets, b, k, m
+    )
+    rec["fp_impl"] = device_fp.get_impl()
+    print(json.dumps(rec))
+
+
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "--impl-leg":
+        # The parent already resolved the platform; honour JAX_PLATFORMS.
+        if os.environ.get("JAX_PLATFORMS") == "cpu":
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        _impl_leg_main(sys.argv[2:])
+    else:
+        main()

@@ -1,0 +1,159 @@
+"""fp.mul microbench: achieved MAC/s per implementation (VERDICT r5 rec #2).
+
+Measures the one kernel every scalar-mul ladder step and Miller-loop
+iteration funnels through (~2/3 of all fp lanes, docs/COST_MODEL.md): a
+jitted ``lax.scan`` chain of DEPTH dependent batched products over N
+lanes, so dispatch overhead amortizes and XLA cannot dead-code the work.
+MAC/s counts the schoolbook contraction only (NCOLS x NL = 2016 MACs per
+lane per step) — reduction overhead is the same real work both
+implementations pay, so the ratio isolates the contraction engine:
+int32 banded dot (VPU-bound on TPU) vs int8 limb-split passes (the MXU
+envelope, 12-bit->(8+5/6) decomposition; see fp.py).
+
+Prints ONE JSON line and writes ``BENCH_FP_MUL.json`` at the repo root;
+``tools/cost_model.py`` folds that artifact into the measured-constants
+table of docs/COST_MODEL.md.
+
+Usage: python benches/bench_fp_mul.py [--n 4096] [--depth 16] [--reps 5]
+       [--impls toeplitz_int32,matmul_int8,pallas_int8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def _measure_impl(name: str, n: int, depth: int, reps: int) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    from lighthouse_tpu.crypto.device import fp
+
+    fp.set_impl(name)
+    jax.clear_caches()  # fp impl dispatch is trace-time; drop stale kernels
+
+    rng = np.random.default_rng(0xF9)
+    x = jnp.asarray(rng.integers(0, fp.MASK + 1, (n, fp.NL), dtype=np.int32))
+    y = jnp.asarray(rng.integers(0, fp.MASK + 1, (n, fp.NL), dtype=np.int32))
+
+    @jax.jit
+    def chain(a, b):
+        def body(acc, _):
+            return fp.mul(acc, b), None
+
+        out, _ = lax.scan(body, a, None, length=depth)
+        return out
+
+    t0 = time.perf_counter()
+    ref = jax.block_until_ready(chain(x, y))
+    compile_s = time.perf_counter() - t0
+
+    samples = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(chain(x, y))
+        samples.append(time.perf_counter() - t0)
+    med = statistics.median(samples)
+    spread = (max(samples) - min(samples)) / med if med else 0.0
+
+    macs = n * depth * fp.NCOLS * fp.NL
+    # cross-impl correctness pin: the FULL canonical output must agree
+    # bit-for-bit across engines (checked by the caller via this digest;
+    # a bytes hash, so compensating differences cannot cancel)
+    import hashlib
+
+    digest = hashlib.sha256(
+        np.ascontiguousarray(np.asarray(fp.canonical(ref))).tobytes()
+    ).hexdigest()
+    return {
+        "impl": name,
+        "mac_per_sec": macs / med,
+        "step_s": med,
+        "rep_spread": round(spread, 3),
+        "compile_s": round(compile_s, 2),
+        "digest": digest,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=4096)
+    ap.add_argument("--depth", type=int, default=16)
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument(
+        "--impls", default="toeplitz_int32,matmul_int8",
+        help="comma list; pallas_int8 is opt-in (interpret mode off-TPU "
+             "is a semantics check, not a speed measurement)",
+    )
+    args = ap.parse_args()
+
+    # Default to the CPU mesh unless a TPU was explicitly requested: this
+    # bench must always print a line, even on relay-less hosts.
+    if "JAX_PLATFORMS" not in os.environ:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    except Exception:
+        pass
+
+    from lighthouse_tpu.crypto.device import fp
+
+    prev = fp.get_impl()
+    rows = []
+    try:
+        for name in args.impls.split(","):
+            rows.append(_measure_impl(name.strip(), args.n, args.depth, args.reps))
+    finally:
+        fp.set_impl(prev)
+        jax.clear_caches()
+
+    digests = {r["digest"] for r in rows}
+    assert len(digests) == 1, f"impls disagree on canonical output: {rows}"
+
+    by_name = {r["impl"]: r for r in rows}
+    ratio = None
+    if "toeplitz_int32" in by_name and "matmul_int8" in by_name:
+        ratio = (
+            by_name["matmul_int8"]["mac_per_sec"]
+            / by_name["toeplitz_int32"]["mac_per_sec"]
+        )
+
+    out = {
+        "metric": "fp_mul_achieved_mac_per_sec",
+        "backend": jax.devices()[0].platform,
+        "n_lanes": args.n,
+        "depth": args.depth,
+        "reps": args.reps,
+        "macs_per_lane": fp.NCOLS * fp.NL,
+        "split_shift": fp.SPLIT_SHIFT,
+        "impls": {
+            r["impl"]: {
+                "mac_per_sec": round(r["mac_per_sec"], 1),
+                "step_s": round(r["step_s"], 5),
+                "rep_spread": r["rep_spread"],
+                "compile_s": r["compile_s"],
+            }
+            for r in rows
+        },
+        "matmul_int8_vs_toeplitz_int32": round(ratio, 3) if ratio else None,
+    }
+    (REPO / "BENCH_FP_MUL.json").write_text(json.dumps(out, indent=1) + "\n")
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -12,6 +12,12 @@ Layout: a base-field element is an ``int32[..., 32]`` array of 12-bit limbs
 so the whole tower/curve/pairing stack is batched by construction — no
 ``vmap`` required. Bounds guaranteeing no int32 overflow are checked by
 interval arithmetic at import time (see ``fp.py``).
+
+The base-field multiply — the funnel the entire stack drains into — is
+selectable via ``LIGHTHOUSE_TPU_FP_IMPL`` (``toeplitz_int32`` int32/VPU,
+``matmul_int8`` int8 limb-split/MXU, ``pallas_int8`` hand-placed kernel;
+see ``fp.py`` and docs/DEVICE_CRYPTO.md); fp2/tower/curve/pairing/bls pick
+the active engine up transparently at trace time.
 """
 
 from .. import backend as _backend

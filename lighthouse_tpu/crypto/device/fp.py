@@ -25,9 +25,33 @@ Design (TPU-first, not a port of blst's 64-bit asm):
 
 Subtraction uses a "saturated" multiple of p (every digit >= LIMB_MAX) so
 ``x - y + SAT`` is limb-wise non-negative — branch-free and select-free.
+
+Multiplication exists in selectable implementations (``FP_IMPL``):
+
+* ``toeplitz_int32`` — the original banded dot over int32 operands.
+  Correct everywhere, but int32 multiplies execute on the TPU VPU
+  (~2e12 MAC/s on v5e), which caps the whole verifier well below target
+  (``docs/COST_MODEL.md``).
+* ``matmul_int8`` — each limb is split into int8-ranged halves
+  (``hi = limb >> SPLIT_SHIFT``, ``lo = limb & SPLIT_MASK``) and the
+  banded product becomes FOUR int8 x int8 -> int32 ``dot_general``
+  passes recombined with shifts — the dtype shape XLA lowers onto the
+  MXU systolic array (~4.9e13 MAC/s envelope). Same column values,
+  machine-checked to recombine without overflow.
+* ``pallas_int8`` — the same int8 decomposition as a hand-placed Pallas
+  kernel (``pallas_fp.py``), for when XLA keeps the int8 dots on the
+  VPU; interpreted off-TPU, so it stays differential-testable.
+
+Select with ``LIGHTHOUSE_TPU_FP_IMPL`` (env, like the BLS backend flag in
+``crypto/backend.py``) or :func:`set_impl` / the :func:`impl` context
+manager. NOTE: callers that hold jitted programs must ``jax.clear_caches()``
+after switching — dispatch happens at trace time.
 """
 
 from __future__ import annotations
+
+import contextlib
+import os
 
 import numpy as np
 
@@ -51,6 +75,22 @@ NCOLS = 2 * NL - 1        # full-product column count
 # Products are accumulated in int32 over *half* the limbs at a time
 # (16 * LIMB_MAX**2 < 2**31); see mul().
 assert (NL // 2) * (LIMB_MAX ** 2) < 2 ** 31, "half-conv columns must fit int32"
+
+# ---------------------------------------------------------------------------
+# int8 limb split (matmul_int8 / pallas_int8 implementations)
+# ---------------------------------------------------------------------------
+# A relaxed limb carries up to 13 bits (LIMB_MAX = 8191), so the paper-style
+# high-8/low-4 split of a strict 12-bit digit does not fit the SIGNED int8
+# operands the MXU consumes natively. The split point is therefore *derived*:
+# the smallest shift whose high half fits int8, which lands at hi = limb >> 6
+# (<= 127) and lo = limb & 63 — a (7+6)-bit split with identical algebra:
+#     x*y = (xh*yh << 2S) + ((xh*yl + xl*yh) << S) + xl*yl,  S = SPLIT_SHIFT
+_INT8_MAX = 127
+SPLIT_SHIFT = next(
+    s for s in range(1, 13) if (LIMB_MAX >> s) <= _INT8_MAX
+)
+SPLIT_MASK = (1 << SPLIT_SHIFT) - 1
+assert (LIMB_MAX >> SPLIT_SHIFT) <= _INT8_MAX and SPLIT_MASK <= _INT8_MAX
 
 
 # ---------------------------------------------------------------------------
@@ -221,12 +261,29 @@ _HALF_BOUNDS = [
     [_overlap(c, _H, NL) * LIMB_MAX ** 2 for c in range(NCOLS)],
 ]
 
+# Exact per-column product bound for the FULL 32-term schoolbook band
+# (the int8 decomposition recombines to the exact column value, so the
+# full-width profile applies; peak 32 * 8191**2 = 2,146,959,392 < 2**31).
+MUL_COL_BOUNDS = [_overlap(c, 0, NL) * LIMB_MAX ** 2 for c in range(NCOLS)]
+assert max(MUL_COL_BOUNDS) < 2 ** 31, "full-band columns must fit int32"
+# The shifted high-high partial is the largest recombination intermediate;
+# machine-check it independently of the exact total.
+assert (
+    NL * (LIMB_MAX >> SPLIT_SHIFT) ** 2 << (2 * SPLIT_SHIFT)
+) < 2 ** 31, "hh<<2S recombination must fit int32"
 
-def mul(x, y):
+
+def band_matrix(y):
+    """Gather ``y`` into the ``[..., NL, NCOLS]`` banded-Toeplitz matrix
+    shared by every mul implementation."""
+    return jnp.take(y, jnp.asarray(_IDX), axis=-1) * jnp.asarray(_BANDMASK)
+
+
+def _mul_toeplitz_int32(x, y):
     """Banded-Toeplitz schoolbook product, split into two 16-limb dots so
     int32 accumulation cannot overflow at LIMB_MAX; each half gets one
     carry round before the halves are combined and reduced."""
-    band = jnp.take(y, jnp.asarray(_IDX), axis=-1) * jnp.asarray(_BANDMASK)
+    band = band_matrix(y)
     halves = []
     for i, sl in enumerate((slice(0, _H), slice(_H, NL))):
         cols = jnp.einsum("...a,...ac->...c", x[..., sl], band[..., sl, :],
@@ -234,6 +291,105 @@ def mul(x, y):
         halves.append(_carry_round(cols, _HALF_BOUNDS[i]))
     (c0, b0), (c1, b1) = halves
     return reduce_cols(c0 + c1, [a + b for a, b in zip(b0, b1)])
+
+
+def split_int8(a):
+    """Stack the int8-ranged halves of limb array ``a`` on a NEW leading
+    axis: ``out[0] = a >> SPLIT_SHIFT`` (<= 127), ``out[1] = a & SPLIT_MASK``
+    (<= 63). Valid for any value in [0, LIMB_MAX]."""
+    return jnp.stack([a >> SPLIT_SHIFT, a & SPLIT_MASK], axis=0).astype(
+        jnp.int8
+    )
+
+
+def recombine_int8_passes(passes):
+    """``passes[i, j] = (x half i) . (band half j)`` int32 columns ->
+    exact product columns via shifts. Overflow-free by the module-level
+    bound asserts (the recombined value equals the int32 schoolbook
+    column, peak ``max(MUL_COL_BOUNDS) < 2**31``)."""
+    hh, hl = passes[0, 0], passes[0, 1]
+    lh, ll = passes[1, 0], passes[1, 1]
+    return (
+        (hh << (2 * SPLIT_SHIFT)) + ((hl + lh) << SPLIT_SHIFT) + ll
+    )
+
+
+def _mul_matmul_int8(x, y):
+    """MXU-decomposed product: both operands split into int8 halves, all
+    four half-products computed by ONE stacked ``dot_general`` over int8
+    operands with int32 accumulation — the operand dtype XLA lowers to
+    MXU matmul passes — then recombined with shifts. No per-half carry
+    rounds are needed: the recombined columns carry the exact full-band
+    bound profile (``MUL_COL_BOUNDS``) and ``reduce_cols`` derives its
+    carry/fold schedule from that, machine-checked as always."""
+    xs = split_int8(x)                      # [2, ..., NL] int8
+    bs = split_int8(band_matrix(y))         # [2, ..., NL, NCOLS] int8
+    passes = jnp.einsum(
+        "i...a,j...ac->ij...c", xs, bs, preferred_element_type=jnp.int32
+    )
+    return reduce_cols(recombine_int8_passes(passes), MUL_COL_BOUNDS)
+
+
+def _mul_pallas_int8(x, y):
+    """The int8 decomposition as a hand-placed Pallas kernel (see
+    ``pallas_fp.py``) for when the dot_general lowering refuses to leave
+    the VPU; interpreted off-TPU so it stays differential-testable."""
+    from . import pallas_fp
+
+    return reduce_cols(pallas_fp.mul_cols_int8(x, y), MUL_COL_BOUNDS)
+
+
+# ---------------------------------------------------------------------------
+# Implementation switch (env-selectable, like crypto/backend.py's backend)
+# ---------------------------------------------------------------------------
+
+IMPL_TOEPLITZ_INT32 = "toeplitz_int32"
+IMPL_MATMUL_INT8 = "matmul_int8"
+IMPL_PALLAS_INT8 = "pallas_int8"
+
+_MUL_IMPLS = {
+    IMPL_TOEPLITZ_INT32: _mul_toeplitz_int32,
+    IMPL_MATMUL_INT8: _mul_matmul_int8,
+    IMPL_PALLAS_INT8: _mul_pallas_int8,
+}
+
+_active_impl = os.environ.get("LIGHTHOUSE_TPU_FP_IMPL", IMPL_TOEPLITZ_INT32)
+if _active_impl not in _MUL_IMPLS:
+    raise KeyError(
+        f"LIGHTHOUSE_TPU_FP_IMPL={_active_impl!r} unknown; "
+        f"have {sorted(_MUL_IMPLS)}"
+    )
+
+
+def get_impl() -> str:
+    return _active_impl
+
+
+def set_impl(name: str) -> None:
+    """Select the fp.mul implementation. Dispatch happens at TRACE time:
+    callers holding jitted programs (e.g. device/bls.py's staged pipeline)
+    must ``jax.clear_caches()`` afterwards or they keep the old kernels."""
+    global _active_impl
+    if name not in _MUL_IMPLS:
+        raise KeyError(f"unknown fp impl {name!r}; have {sorted(_MUL_IMPLS)}")
+    _active_impl = name
+
+
+@contextlib.contextmanager
+def impl(name: str):
+    """Scoped implementation switch (restores the previous choice)."""
+    prev = _active_impl
+    set_impl(name)
+    try:
+        yield
+    finally:
+        set_impl(prev)
+
+
+def mul(x, y):
+    """Schoolbook product mod p under the active implementation — the
+    single funnel every fp2/fp6/fp12/curve/pairing multiply drains into."""
+    return _MUL_IMPLS[_active_impl](x, y)
 
 
 def sq(x):

@@ -6,6 +6,10 @@ dims, mirroring the host oracle ``crypto/cpu/fields.Fq2`` (tested for
 bit-equality against it). Reference behaviour being reproduced: the Fp2
 tower inside blst (``/root/reference/crypto/bls/src/impls/blst.rs`` links
 the asm backend).
+
+Every product here drains into :func:`fp.mul` and therefore inherits the
+active ``FP_IMPL`` engine (int32 Toeplitz dot / int8 MXU decomposition /
+Pallas kernel) without any change at this layer.
 """
 
 from __future__ import annotations

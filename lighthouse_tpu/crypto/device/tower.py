@@ -9,6 +9,10 @@ Algorithms mirror the host oracle ``crypto/cpu/fields.{Fq6,Fq12}`` (tested
 for bit-equality), expressed over the batched :mod:`.fp2` primitives.
 Frobenius constants are computed at import from public curve parameters
 (same derivation as the oracle's ``GAMMA6_1/GAMMA6_2/GAMMA12``).
+
+All 27/18/81-lane product stacks funnel into :func:`fp.mul`, so the tower
+inherits the active ``FP_IMPL`` contraction engine (int32 VPU dot or the
+int8 MXU decomposition) transparently.
 """
 
 from __future__ import annotations

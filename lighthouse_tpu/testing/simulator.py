@@ -65,13 +65,34 @@ class LocalNetwork:
         boot = self.nodes[0]
         for node in self.nodes[1:]:
             node.net.connect("127.0.0.1", boot.net.port)
+        # dial() registers the peer on the DIALING side synchronously, but
+        # the bootnode's accept-loop thread registers inbound peers after
+        # its half of the handshake — callers touching
+        # ``nodes[0].net.transport.peers`` right after construction raced
+        # that thread (the one red test in the default gate). Block until
+        # every inbound peer is registered.
+        self._wait_inbound(boot, n_nodes - 1)
         self.validator_owner = {
             v: v % n_nodes for v in range(validator_count)
         }
 
+    @staticmethod
+    def _wait_inbound(node: LocalNode, n: int, timeout: float = 5.0) -> None:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if node.net.transport.peer_count() >= n:
+                return
+            time.sleep(0.01)
+        raise AssertionError(
+            f"bootnode registered {node.net.transport.peer_count()} of "
+            f"{n} inbound peers within {timeout}s"
+        )
+
     def add_node(self) -> LocalNode:
         node = LocalNode(self.h, self.genesis, self.clock)
+        have = self.nodes[0].net.transport.peer_count()
         node.net.connect("127.0.0.1", self.nodes[0].net.port)
+        self._wait_inbound(self.nodes[0], have + 1)
         self.nodes.append(node)
         return node
 

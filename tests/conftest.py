@@ -33,11 +33,23 @@ jax.config.update("jax_platforms", "cpu")
 # compiles the small fp/fp2/htc graphs.
 
 import random  # noqa: E402
+import sys  # noqa: E402
 
 import pytest  # noqa: E402
 
 assert jax.devices()[0].platform == "cpu", "tests must run on the CPU mesh"
 assert len(jax.devices()) == 8, "expected the virtual 8-device CPU mesh"
+
+
+@pytest.hookimpl(trylast=True)
+def pytest_runtest_logreport(report):
+    """Flush the progress stream after every test report. The tier-1 gate
+    runs under ``timeout`` with output tee'd to a log; stdout to a pipe is
+    BLOCK-buffered, so on SIGTERM the last unflushed buffer of progress
+    dots was simply lost and the recorded pass count lotteried on flush
+    boundaries (observed spread: tens of dots between identical runs).
+    Flushing per test makes a truncated log reflect true progress."""
+    sys.stdout.flush()
 
 
 @pytest.fixture

@@ -16,6 +16,16 @@ from lighthouse_tpu.crypto.cpu.curve import (
 from lighthouse_tpu.crypto.device import curve, fp, fp2
 
 
+@pytest.fixture(
+    autouse=True,
+    params=[fp.IMPL_TOEPLITZ_INT32, fp.IMPL_MATMUL_INT8],
+)
+def _fp_impl(request):
+    """Curve-level differential coverage for both fp.mul engines."""
+    with fp.impl(request.param):
+        yield request.param
+
+
 def _g1_points(rng, n):
     g = g1_generator()
     return [g.mul(rng.randrange(1, 1 << 64)) for _ in range(n)]

@@ -1,4 +1,8 @@
-"""Device Fp2 arithmetic vs the pure-Python Fq2 oracle."""
+"""Device Fp2 arithmetic vs the pure-Python Fq2 oracle.
+
+Runs under the DEFAULT fp.mul implementation; re-collected under the
+int8 limb-split engine by ``test_zgate1_fp_impl_matrix.py`` (tail-sorted,
+see that module's docstring)."""
 
 import numpy as np
 

@@ -14,6 +14,16 @@ from lighthouse_tpu.crypto.device import curve, fp, fp2, pairing, tower
 import jax.numpy as jnp
 
 
+@pytest.fixture(
+    autouse=True,
+    params=[fp.IMPL_TOEPLITZ_INT32, fp.IMPL_MATMUL_INT8],
+)
+def _fp_impl(request):
+    """Tower/pairing-level differential coverage for both fp.mul engines."""
+    with fp.impl(request.param):
+        yield request.param
+
+
 def _rand_f12(rng, n):
     def f2():
         return Fq2.from_ints(rng.randrange(P), rng.randrange(P))

@@ -1,0 +1,56 @@
+"""The fp/fp2 differential suites, re-collected under the int8 limb-split
+``fp.mul`` engine (``FP_IMPL=matmul_int8``), plus the dedicated Pallas
+kernel differential.
+
+Every test function of ``test_device_fp.py`` and ``test_device_fp2.py``
+is imported and re-run here with the module-scoped autouse fixture
+switching the contraction engine — the acceptance bar for the MXU
+decomposition is "passes every existing fp/fp2 differential test", and
+re-collection keeps that true BY CONSTRUCTION as those suites grow.
+(Dispatch is eager/trace-time, so no jit-cache clearing is needed at
+this layer; the slow curve/pairing suites carry their own both-engine
+parametrization.)
+
+Named ``test_zgate1_*`` so the doubled runtime collects AFTER the
+functional suite (the tier-1 gate runs under a hard wall-clock, and the
+second engine's pass must never displace first-engine functional
+coverage inside that window) but BEFORE the compile-heavy zgate2/zgate3
+gates — this matrix is seconds of eager work and must not hide behind
+minutes of XLA compile when the window is nearly spent.
+"""
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.crypto.params import P
+from lighthouse_tpu.crypto.device import fp
+
+from test_device_fp import *      # noqa: F401,F403
+from test_device_fp2 import *     # noqa: F401,F403
+from test_device_fp import EDGES, _pack, _rand_elems, _val
+
+
+@pytest.fixture(autouse=True)
+def _fp_impl():
+    with fp.impl(fp.IMPL_MATMUL_INT8):
+        yield
+
+
+def test_pallas_impl_differential(rng):
+    """The Pallas int8 kernel agrees with the oracle and the other two
+    implementations, including the worst-case relaxed operand (every limb
+    at LIMB_MAX) and non-tile-multiple batch sizes (padding path)."""
+    xs = _rand_elems(rng, 5) + EDGES
+    ys = EDGES + _rand_elems(rng, 5)
+    X, Y = _pack(xs), _pack(ys)
+    relaxed = np.full((1, fp.NL), fp.LIMB_MAX, np.int32)
+    with fp.impl(fp.IMPL_PALLAS_INT8):
+        assert _val(fp.mul(X, Y)) == [(a * b) % P for a, b in zip(xs, ys)]
+        out = np.asarray(fp.mul(relaxed, relaxed))
+        assert out.min() >= 0 and out.max() <= fp.LIMB_MAX
+        v = fp.limbs_to_int(relaxed[0])
+        assert fp.limbs_to_int(out[0]) % P == (v * v) % P
+        # broadcast + odd leading shape exercises the flatten/pad path
+        X3 = _pack(xs[:3]).reshape(3, fp.NL)
+        out3 = np.asarray(fp.mul(X3[None], X3[:1])).reshape(3, fp.NL)
+        assert _val(out3) == [(a * xs[0]) % P for a in xs[:3]]

@@ -1,0 +1,37 @@
+"""Staged-program SIZE regression gate (VERDICT r5 rec #3).
+
+Compile time is a tracked metric — 120.7 s warm-up in BENCH_r05 even at
+the shrunk fallback shapes, 223.8 s/shape in DP_SCALING — and XLA's cost
+tracks emitted program size, so this pins the pre-optimization StableHLO
+instruction count of each staged program (lowering only: tracing is
+seconds, compiling is minutes, and size regressions show up at lowering).
+
+Budgets are the measured counts at B=4/K=2/M=2 (stage1 24,399 / stage2
+11,694 / stage3 29,716) plus ~25% headroom: loose enough for routine
+drift, tight enough that an unrolled-scan or per-lane-ladder regression
+(the historical causes, docs/DEVICE_CRYPTO.md 'Compile-time engineering')
+trips it.
+
+Named ``test_zgate2_*`` so it collects AFTER the functional suite and
+the cheap zgate1 differential matrix: the tier-1 gate runs under a hard
+wall-clock, and a size gate must never displace functional coverage
+inside that window.
+"""
+
+from tools.hlo_stats import staged_instruction_counts
+
+BUDGETS = {"stage1": 31_000, "stage2": 15_000, "stage3": 38_000}
+
+
+def test_staged_hlo_instruction_budget():
+    counts = staged_instruction_counts(B=4, K=2, M=2)
+    assert set(counts) == set(BUDGETS)
+    for stage, rec in counts.items():
+        n = rec["instructions"]
+        assert n > 0, f"{stage}: instruction count unavailable"
+        assert n <= BUDGETS[stage], (
+            f"{stage} grew to {n} HLO instructions "
+            f"(budget {BUDGETS[stage]}); compile time scales with this — "
+            f"either shrink the program (scan the new structure) or "
+            f"consciously raise the budget here"
+        )

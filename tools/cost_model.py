@@ -13,6 +13,7 @@ Every formula cites the code it models. Run:  python tools/cost_model.py
 
 from __future__ import annotations
 
+import json
 import sys
 from pathlib import Path
 
@@ -120,8 +121,9 @@ SCENARIOS = {
     ),
     "v5e MXU via 12-bit->int8 split": (
         4.9e13,
-        "XLA lowers the int32 dot to 4 int8 MXU passes (12-bit limbs "
-        "split 8+4): 394 TOPS int8 / 4 passes / 2 (ops->MACs)",
+        "the FP_IMPL=matmul_int8 path (fp.py): limbs split into signed-"
+        "int8 halves (hi=limb>>6, lo=limb&63), 4 int8 MXU passes: "
+        "394 TOPS int8 / 4 passes / 2 (ops->MACs)",
     ),
 }
 
@@ -191,6 +193,32 @@ def main() -> None:
     for label, (rate, note) in SCENARIOS.items():
         w(f"- **{label}**: {rate:.1e} int32 MAC/s — {note}.")
     w("")
+    # Measured fp.mul constants (benches/bench_fp_mul.py artifact). The
+    # analytic scenarios above are ENVELOPES; this table is what the two
+    # contraction engines actually achieve on the backend the bench ran on.
+    mpath = REPO / "BENCH_FP_MUL.json"
+    if mpath.exists():
+        m = json.loads(mpath.read_text())
+        w("## Measured fp.mul throughput (benches/bench_fp_mul.py)")
+        w("")
+        w(f"Backend `{m['backend']}`, {m['n_lanes']} lanes x depth "
+          f"{m['depth']} chained products, median of {m['reps']} reps, "
+          f"{m['macs_per_lane']} MACs/lane; int8 split shift "
+          f"{m['split_shift']} (hi = limb>>{m['split_shift']} <= 127).")
+        w("")
+        w("| FP_IMPL | achieved MAC/s | step_s | spread | compile_s |")
+        w("|---|---|---|---|---|")
+        for name, r in m["impls"].items():
+            w(f"| {name} | {r['mac_per_sec']:.3e} | {r['step_s']:.5f} | "
+              f"{r['rep_spread']} | {r['compile_s']} |")
+        ratio = m.get("matmul_int8_vs_toeplitz_int32")
+        if ratio is not None:
+            w("")
+            w(f"matmul_int8 / toeplitz_int32 achieved-MAC/s ratio: "
+              f"**{ratio}x** on this backend. The MXU claim in the table "
+              "above is only validated by a run with backend `tpu`; a CPU "
+              "ratio measures XLA:CPU's int8 vs int32 vectorization.")
+        w("")
     w("## Reading the table")
     w("")
     w("- The 50k agg/s target (150k sets/s, BASELINE.json) needs ~"

@@ -23,19 +23,27 @@ from lighthouse_tpu.crypto.device import curve, fp, fp2, htc, pairing, tower
 B = int(sys.argv[1]) if len(sys.argv) > 1 else 16
 
 
+from tools.hlo_stats import (  # noqa: E402
+    hlo_instruction_count,
+    staged_instruction_counts,
+)
+
+
 def clock(name, fn, *args):
     t0 = time.perf_counter()
     lowered = jax.jit(fn).lower(*args)
     t1 = time.perf_counter()
     try:
-        n_lines = len(lowered.as_text().splitlines())
+        text = lowered.as_text()  # rendered ONCE; both stats come from it
+        n_lines = len(text.splitlines())
+        n_instr = hlo_instruction_count(text)
     except Exception:
-        n_lines = -1
+        n_lines = n_instr = -1
     lowered.compile()
     t2 = time.perf_counter()
     print(
         f"{name:28s} lower {t1-t0:7.2f}s  compile {t2-t1:7.2f}s  "
-        f"hlo_lines {n_lines}",
+        f"hlo_lines {n_lines}  hlo_instr {n_instr}",
         flush=True,
     )
 
@@ -106,3 +114,17 @@ clock("curve.to_affine_g2", lambda p: curve.to_affine(fp2, p), g2pt)
 clock("fp2.mul", fp2.mul, f2, f2)
 clock("fp2.sq", fp2.sq, f2)
 clock("fp.canonical", fp.canonical, f2[:, 0])
+
+# Per-stage instruction accounting for the staged flagship (VERDICT r5
+# rec #3: compile time is a tracked metric; instruction count is its
+# shape-stable proxy). One JSON line so drivers/rounds can diff it.
+import json  # noqa: E402
+
+_staged = staged_instruction_counts(B, K=8, M=4)
+for _name, _rec in _staged.items():
+    print(
+        f"{_name:28s} lower {_rec['lower_s']:7.2f}s  "
+        f"hlo_instr {_rec['instructions']}",
+        flush=True,
+    )
+print(json.dumps({"B": B, "K": 8, "M": 4, "staged_hlo": _staged}))
