@@ -262,8 +262,10 @@ def main() -> None:
 
     _configure_jax_cache(jax)
 
+    from lighthouse_tpu.crypto.device import fp as device_fp
     from lighthouse_tpu.crypto.device.bls import (
         pack_signature_sets_raw,
+        stage_latency_summary,
         verify_batch_raw_staged,
     )
 
@@ -272,6 +274,10 @@ def main() -> None:
         pack_signature_sets_raw, verify_batch_raw_staged, sets,
         B_PAD, K_PAD, M_PAD,
     )
+    # Per-stage attribution from the new telemetry histograms, read
+    # BEFORE the extra buckets run so the quantiles describe the headline
+    # geometry (the family keeps accumulating across buckets).
+    headline["stage_latency"] = stage_latency_summary(device_fp.get_impl())
 
     buckets = [headline]
     for spec in EXTRA_BUCKETS:
@@ -300,8 +306,6 @@ def main() -> None:
     # this process has segfaulted before (see dryrun_multichip), and a
     # wedge there must not cost the already-measured headline line.
     # Skipped-with-marker beats silent truncation.
-    from lighthouse_tpu.crypto.device import fp as device_fp
-
     headline_impl = device_fp.get_impl()
     alt_impl = (
         device_fp.IMPL_MATMUL_INT8
@@ -359,6 +363,7 @@ def main() -> None:
                            "n_sets": headline["n_sets"]},
                 "fp_impl": headline_impl,
                 "fp_impl_legs": impl_legs,
+                "stage_latency": headline.get("stage_latency", {}),
                 "buckets": buckets,
             }
         )
@@ -391,6 +396,7 @@ def _impl_leg_main(argv) -> None:
     from lighthouse_tpu.crypto.device import fp as device_fp
     from lighthouse_tpu.crypto.device.bls import (
         pack_signature_sets_raw,
+        stage_latency_summary,
         verify_batch_raw_staged,
     )
 
@@ -399,6 +405,7 @@ def _impl_leg_main(argv) -> None:
         pack_signature_sets_raw, verify_batch_raw_staged, sets, b, k, m
     )
     rec["fp_impl"] = device_fp.get_impl()
+    rec["stage_latency"] = stage_latency_summary(device_fp.get_impl())
     print(json.dumps(rec))
 
 

@@ -22,19 +22,60 @@ from ..state_transition.signature_sets import (
     aggregate_and_proof_sets,
     indexed_attestation_set,
 )
-from ..utils import metrics
+from ..utils import metrics, tracing
 
 ATTESTATION_PROPAGATION_SLOT_RANGE = 32
 TARGET_AGGREGATORS_PER_COMMITTEE = 16
 
-_BATCH_SETUP = metrics.histogram(
+_BATCH_SETUP = metrics.histogram_vec(
     "attestation_batch_setup_seconds",
     "structural checks + set building for a gossip attestation batch",
+    ("kind",),
 )
-_BATCH_SIG = metrics.histogram(
+_BATCH_SIG = metrics.histogram_vec(
     "attestation_batch_signature_seconds",
     "backend batch signature verification for a gossip attestation batch",
+    ("kind",),
 )
+_VERIFY_SECONDS = metrics.histogram_vec(
+    "attestation_verification_seconds",
+    "full gossip-to-verdict wall time (mode=batch: one sample per "
+    "N-item batch; mode=single: one per item)",
+    ("kind", "mode"),
+)
+_OUTCOMES = metrics.counter_vec(
+    "attestation_verification_outcomes_total",
+    "per-item gossip attestation verdicts (outcome = ok or the error kind)",
+    ("kind", "outcome"),
+)
+
+
+def _count_outcomes(kind: str, results) -> None:
+    for r in results:
+        _OUTCOMES.with_labels(
+            kind, r.kind if isinstance(r, AttestationError) else "ok"
+        ).inc()
+
+
+def _observed(kind: str):
+    """Single-item paths: same latency family + outcome accounting as the
+    batch paths, so dashboards see one verdict stream per kind."""
+    import functools
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(chain, item, current_slot):
+            with tracing.span("attestation.verify", kind=kind), \
+                    _VERIFY_SECONDS.with_labels(kind, "single").time():
+                try:
+                    out = fn(chain, item, current_slot)
+                except AttestationError as e:
+                    _OUTCOMES.with_labels(kind, e.kind).inc()
+                    raise
+                _OUTCOMES.with_labels(kind, "ok").inc()
+                return out
+        return wrapper
+    return deco
 
 
 class AttestationError(ValueError):
@@ -103,6 +144,7 @@ def _structural_unaggregated(chain, att, current_slot: int):
     return indexed, validator_index
 
 
+@_observed("unaggregated")
 def verify_unaggregated_attestation(chain, att, current_slot: int):
     """Single-item gossip path (reference
     ``IndexedUnaggregatedAttestation::verify``)."""
@@ -143,43 +185,50 @@ def batch_verify_unaggregated_attestations(chain, attestations, current_slot: in
     verify concurrently; setup and the observed-cache commit take it."""
     results: list[object] = [None] * len(attestations)
     pending = []  # (pos, att, indexed, validator_index, set)
-    with chain._chain_lock, _BATCH_SETUP.time():
-        for pos, att in enumerate(attestations):
-            try:
-                indexed, vindex = _structural_unaggregated(chain, att, current_slot)
-                s = indexed_attestation_set(
-                    chain.preset, chain.spec, chain.head_state, indexed,
-                    chain.pubkey_cache.resolver(),
-                )
-                pending.append((pos, att, indexed, vindex, s))
-            except AttestationError as e:
-                results[pos] = e
-            except BlsError:
-                results[pos] = AttestationError("InvalidSignature")
-    with _BATCH_SIG.time():
-        batch_ok = bool(pending) and bls.verify_signature_sets(
-            [p[4] for p in pending]
-        )
-        # per-item fallback (reference batch.rs:115-119) — still unlocked
-        item_ok = {
-            p[0]: batch_ok or bls.verify_signature_sets([p[4]])
-            for p in pending
-        }
-    with chain._chain_lock:
-        for pos, att, indexed, vindex, s in pending:
-            if item_ok[pos]:
-                # observe() returning True = duplicate within this batch or
-                # a racing thread (the pre-batch is_known check ran before
-                # any item was observed); reject it exactly as the
-                # sequential path would.
-                if chain.observed_attesters.observe(vindex, att.data.target.epoch):
-                    results[pos] = AttestationError("PriorAttestationKnown")
-                else:
-                    results[pos] = VerifiedUnaggregatedAttestation(
-                        att, indexed, vindex, att.data.index
+    with tracing.span(
+        "attestation.batch_verify", kind="unaggregated",
+        n=len(attestations),
+    ), _VERIFY_SECONDS.with_labels("unaggregated", "batch").time():
+        with chain._chain_lock, tracing.span("attestation.setup"), \
+                _BATCH_SETUP.with_labels("unaggregated").time():
+            for pos, att in enumerate(attestations):
+                try:
+                    indexed, vindex = _structural_unaggregated(chain, att, current_slot)
+                    s = indexed_attestation_set(
+                        chain.preset, chain.spec, chain.head_state, indexed,
+                        chain.pubkey_cache.resolver(),
                     )
-            else:
-                results[pos] = AttestationError("InvalidSignature")
+                    pending.append((pos, att, indexed, vindex, s))
+                except AttestationError as e:
+                    results[pos] = e
+                except BlsError:
+                    results[pos] = AttestationError("InvalidSignature")
+        with tracing.span("attestation.signature", n_sets=len(pending)), \
+                _BATCH_SIG.with_labels("unaggregated").time():
+            batch_ok = bool(pending) and bls.verify_signature_sets(
+                [p[4] for p in pending]
+            )
+            # per-item fallback (reference batch.rs:115-119) — still unlocked
+            item_ok = {
+                p[0]: batch_ok or bls.verify_signature_sets([p[4]])
+                for p in pending
+            }
+        with chain._chain_lock:
+            for pos, att, indexed, vindex, s in pending:
+                if item_ok[pos]:
+                    # observe() returning True = duplicate within this batch or
+                    # a racing thread (the pre-batch is_known check ran before
+                    # any item was observed); reject it exactly as the
+                    # sequential path would.
+                    if chain.observed_attesters.observe(vindex, att.data.target.epoch):
+                        results[pos] = AttestationError("PriorAttestationKnown")
+                    else:
+                        results[pos] = VerifiedUnaggregatedAttestation(
+                            att, indexed, vindex, att.data.index
+                        )
+                else:
+                    results[pos] = AttestationError("InvalidSignature")
+    _count_outcomes("unaggregated", results)
     return results
 
 
@@ -228,6 +277,7 @@ def _structural_aggregated(chain, signed_agg, current_slot: int):
     return indexed, att_root
 
 
+@_observed("aggregate")
 def verify_aggregated_attestation(chain, signed_agg, current_slot: int):
     """Single aggregate: 3 signature sets (reference ``batch.rs:77-107``).
     Same lock discipline as the unaggregated path: BLS runs unlocked."""
@@ -266,7 +316,21 @@ def batch_verify_aggregated_attestations(chain, signed_aggs, current_slot: int):
     (reference ``batch.rs:31-134``). BLS runs outside the chain lock."""
     results: list[object] = [None] * len(signed_aggs)
     pending = []
-    with chain._chain_lock, _BATCH_SETUP.time():
+    with tracing.span(
+        "attestation.batch_verify", kind="aggregate", n=len(signed_aggs),
+    ), _VERIFY_SECONDS.with_labels("aggregate", "batch").time():
+        _batch_verify_aggregated_inner(
+            chain, signed_aggs, current_slot, results, pending
+        )
+    _count_outcomes("aggregate", results)
+    return results
+
+
+def _batch_verify_aggregated_inner(
+    chain, signed_aggs, current_slot, results, pending
+):
+    with chain._chain_lock, tracing.span("attestation.setup"), \
+            _BATCH_SETUP.with_labels("aggregate").time():
         for pos, sa in enumerate(signed_aggs):
             try:
                 indexed, att_root = _structural_aggregated(chain, sa, current_slot)
@@ -279,7 +343,8 @@ def batch_verify_aggregated_attestations(chain, signed_aggs, current_slot: int):
                 results[pos] = e
             except BlsError:
                 results[pos] = AttestationError("InvalidSignature")
-    with _BATCH_SIG.time():
+    with tracing.span("attestation.signature", n_sets=3 * len(pending)), \
+            _BATCH_SIG.with_labels("aggregate").time():
         all_sets = [s for p in pending for s in p[4]]
         batch_ok = bool(pending) and bls.verify_signature_sets(all_sets)
         item_ok = {

@@ -24,6 +24,19 @@ from ..state_transition import (
 )
 from ..state_transition.epoch import fork_of
 from ..state_transition.signature_sets import block_proposal_set
+from ..utils import metrics, tracing
+
+_STAGE_SECONDS = metrics.histogram_vec(
+    "beacon_block_verification_seconds",
+    "block import pipeline: per-stage wall time (gossip = structure + "
+    "proposer + proposal signature; signature = full-block batch)",
+    ("stage",),
+)
+_OUTCOMES = metrics.counter_vec(
+    "beacon_block_verification_outcomes_total",
+    "block verification verdicts per stage (outcome = ok or BlockError kind)",
+    ("stage", "outcome"),
+)
 
 
 class BlockError(ValueError):
@@ -43,6 +56,19 @@ class GossipVerifiedBlock:
 
     @classmethod
     def new(cls, chain, signed_block):
+        with tracing.span(
+            "block.gossip_verify", slot=int(signed_block.message.slot)
+        ), _STAGE_SECONDS.with_labels("gossip").time():
+            try:
+                out = cls._new_inner(chain, signed_block)
+            except BlockError as e:
+                _OUTCOMES.with_labels("gossip", e.kind).inc()
+                raise
+            _OUTCOMES.with_labels("gossip", "ok").inc()
+            return out
+
+    @classmethod
+    def _new_inner(cls, chain, signed_block):
         block = signed_block.message
         block_root = hash_tree_root(block)
         current_slot = chain.slot()
@@ -119,19 +145,26 @@ class SignatureVerifiedBlock:
     def _verify(cls, chain, signed_block, block_root, state, skip_proposal):
         from ..crypto.bls import BlsError
 
-        try:
-            acc = BlockSignatureAccumulator(
-                chain.preset, chain.spec, state, chain.pubkey_cache.resolver(),
-                resolver_by_pubkey_bytes=chain.pubkey_resolver_by_bytes(),
-            )
-            if skip_proposal:
-                acc.include_randao_reveal(signed_block.message)
-                acc.include_operations(signed_block)
-            else:
-                acc.include_all(signed_block, block_root=block_root)
-            ok = acc.verify()
-        except BlsError:  # malformed signature bytes in the block body
-            ok = False
+        with tracing.span(
+            "block.signature_verify", slot=int(signed_block.message.slot),
+            skip_proposal=skip_proposal,
+        ), _STAGE_SECONDS.with_labels("signature").time():
+            try:
+                acc = BlockSignatureAccumulator(
+                    chain.preset, chain.spec, state, chain.pubkey_cache.resolver(),
+                    resolver_by_pubkey_bytes=chain.pubkey_resolver_by_bytes(),
+                )
+                if skip_proposal:
+                    acc.include_randao_reveal(signed_block.message)
+                    acc.include_operations(signed_block)
+                else:
+                    acc.include_all(signed_block, block_root=block_root)
+                ok = acc.verify()
+            except BlsError:  # malformed signature bytes in the block body
+                ok = False
+        _OUTCOMES.with_labels(
+            "signature", "ok" if ok else "InvalidSignature"
+        ).inc()
         if not ok:
             raise BlockError("InvalidSignature")
         return cls(signed_block, block_root, state, skip_proposal)

@@ -10,9 +10,17 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
-from ..utils import logging, metrics
+from ..utils import logging, metrics, tracing
 
 _QUEUE_LEN = metrics.gauge("beacon_processor_queue_total", "queued work items")
+_WORK_TOTAL = metrics.counter_vec(
+    "beacon_processor_work_total", "work items executed per kind", ("kind",)
+)
+_HANDLE_SECONDS = metrics.histogram_vec(
+    "beacon_processor_handle_seconds",
+    "handler execution wall time per drained batch",
+    ("kind",),
+)
 _BATCH_SIZE = metrics.histogram(
     "beacon_processor_batch_size",
     "coalesced attestation batch sizes",
@@ -167,6 +175,13 @@ class BeaconProcessor:
         handler = self.handlers.get(kind)
         if handler is None:
             return
+        _WORK_TOTAL.with_labels(kind.name).inc(len(batch))
+        with tracing.span(
+            "beacon_processor.execute", kind=kind.name, batch=len(batch)
+        ), _HANDLE_SECONDS.with_labels(kind.name).time():
+            self._execute_inner(kind, batch, handler)
+
+    def _execute_inner(self, kind: WorkKind, batch: list[Work], handler) -> None:
         if kind in self.batch_ceilings:
             try:
                 results = handler([w.item for w in batch])

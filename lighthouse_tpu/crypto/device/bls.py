@@ -32,12 +32,15 @@ semantics (empty batch / empty set / infinity signature => False) live in
 from __future__ import annotations
 
 import secrets
+import threading
+import time
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
 
+from ...utils import metrics, tracing
 from ..params import DST, G1_X, G1_Y, P, R, X
 from ..cpu.pairing import PSI_CX, PSI_CY
 from ..cpu.hash_to_curve import hash_to_g2
@@ -369,18 +372,147 @@ _stage2 = jax.jit(_stage2_fn)
 _stage3 = jax.jit(_stage3_fn)
 
 
+# ---------------------------------------------------------------------------
+# Hot-path telemetry (reference: beacon_chain/src/metrics.rs label-vector
+# families). Per-stage wall time is measured dispatch-to-sync
+# (block_until_ready): attribution needs the sync boundary, at the cost of
+# host dispatch no longer running ahead of the device between stages —
+# three extra host-device round trips per batch, microseconds against
+# stage bodies that run for hundreds of milliseconds of device work.
+# ---------------------------------------------------------------------------
+
+_STAGE_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0, 600.0,
+)
+_STAGE_SECONDS = metrics.histogram_vec(
+    "bls_device_stage_seconds",
+    "staged device BLS verifier: per-stage wall time, dispatch to device "
+    "sync (first observation per shape includes jit compile)",
+    ("stage", "fp_impl"),
+    buckets=_STAGE_BUCKETS,
+)
+_VERIFY_SECONDS = metrics.histogram_vec(
+    "bls_device_verify_seconds",
+    "end-to-end verify_signature_sets wall time (pack + all stages)",
+    ("path", "fp_impl"),
+    buckets=_STAGE_BUCKETS,
+)
+_PACK_SECONDS = metrics.histogram(
+    "bls_device_pack_seconds",
+    "host-side batch packing (byte wrangling, randomness, hash_to_field)",
+)
+_RECOMPILES = metrics.counter_vec(
+    "bls_device_recompiles_total",
+    "fresh (shape, dtype, fp_impl) argument signatures per staged program "
+    "— each one costs an XLA compile, assuming callers follow the "
+    "fp.set_impl contract (fp.py): switch impls only with "
+    "jax.clear_caches(), paired here with reset_recompile_tracking()",
+    ("stage",),
+)
+_LANES = metrics.counter_vec(
+    "bls_device_batch_lanes_total",
+    "batch geometry: requested vs padded lane counts per dimension "
+    "(B sets, K pubkey slots, M unique messages)",
+    ("dim", "kind"),
+)
+_PAD_WASTE = metrics.gauge(
+    "bls_device_padding_waste_ratio",
+    "1 - real pubkey slots / (B*K) for the most recent packed batch",
+)
+_OUTCOMES = metrics.counter_vec(
+    "bls_device_verify_outcomes_total",
+    "verify_signature_sets verdicts (rejected = host pre-screen)",
+    ("outcome",),
+)
+
+_seen_stage_shapes: set = set()
+_seen_lock = threading.Lock()
+
+
+def reset_recompile_tracking() -> None:
+    """Forget seen argument signatures. Call alongside
+    ``jax.clear_caches()`` (the ``fp.set_impl`` workflow): XLA will
+    recompile every program, and the recompile counter should see the
+    next dispatches as fresh rather than silently absorbing the cost."""
+    with _seen_lock:
+        _seen_stage_shapes.clear()
+
+
+def _run_stage(stage: str, fn, *args):
+    """One staged dispatch: recompile accounting keyed on the argument
+    (shape, dtype, fp_impl) signature, span + labeled wall-time histogram
+    closed at the device sync boundary."""
+    impl = fp.get_impl()
+    key = (
+        stage,
+        impl,
+        tuple((tuple(a.shape), str(a.dtype)) for a in args),
+    )
+    with tracing.span(f"bls.{stage}", fp_impl=impl):
+        t0 = time.perf_counter()
+        out = jax.block_until_ready(fn(*args))
+        _STAGE_SECONDS.with_labels(stage, impl).observe(
+            time.perf_counter() - t0
+        )
+    # seen only after a SUCCESSFUL dispatch: a failed first compile must
+    # not consume the signature's fresh tick (the retry pays the compile)
+    with _seen_lock:
+        fresh = key not in _seen_stage_shapes
+        if fresh:
+            _seen_stage_shapes.add(key)
+    if fresh:
+        _RECOMPILES.with_labels(stage).inc()
+    return out
+
+
+def stage_latency_summary(impl: str | None = None) -> dict:
+    """Rows of {fp_impl, p50_s, p99_s, mean_s, count} read from the
+    ``bls_device_stage_seconds`` family — the one reader bench.py and
+    tools/trace_report.py share. With ``impl`` the rows are keyed by
+    stage; with ``impl=None`` every engine is reported, keyed
+    ``stage:fp_impl`` so one engine cannot shadow another. Quantiles are
+    histogram-bucket upper bounds (None = beyond the top bucket); count
+    says how many dispatches (compiles included) each row aggregates."""
+    import math
+
+    def _finite(q):
+        return q if math.isfinite(q) else None  # keep the JSON strict
+
+    out = {}
+    for (stage, child_impl), child in sorted(_STAGE_SECONDS.children().items()):
+        if impl is not None and child_impl != impl:
+            continue
+        total, sum_, _cum = child.snapshot()
+        if total:
+            key = stage if impl is not None else f"{stage}:{child_impl}"
+            out[key] = {
+                "fp_impl": child_impl,
+                "p50_s": _finite(child.quantile(0.5)),
+                "p99_s": _finite(child.quantile(0.99)),
+                "mean_s": round(sum_ / total, 4),
+                "count": total,
+            }
+    return out
+
+
 def verify_batch_raw_staged(
     pk_xy, pk_mask, sig_x, sig_larger, msg_u, msg_idx, rand_bits, set_mask
 ):
     """Staged equivalent of ``verify_batch_raw`` (same inputs, same
     verdict): three device dispatches, intermediates stay on device."""
-    sig_xy, mx, my, minf, sig_ok = _stage1(sig_x, sig_larger, msg_u)
-    outs = _stage2(pk_xy, pk_mask, sig_xy, rand_bits, set_mask)
+    sig_xy, mx, my, minf, sig_ok = _run_stage(
+        "stage1", _stage1, sig_x, sig_larger, msg_u
+    )
+    outs = _run_stage(
+        "stage2", _stage2, pk_xy, pk_mask, sig_xy, rand_bits, set_mask
+    )
     pk_x, pk_y, pk_inf, acc_x, acc_y, acc_inf, flags_ok = outs
     msg_aff_x = jnp.take(mx, msg_idx, axis=0)
     msg_aff_y = jnp.take(my, msg_idx, axis=0)
     msg_aff_inf = jnp.take(minf, msg_idx, axis=0)
-    pair_ok = _stage3(
+    pair_ok = _run_stage(
+        "stage3", _stage3,
         pk_x, pk_y, pk_inf, msg_aff_x, msg_aff_y, msg_aff_inf,
         acc_x, acc_y, acc_inf,
     )
@@ -596,18 +728,54 @@ class TpuBackend:
 
         sets = list(sets)
         if not sets:
+            _OUTCOMES.with_labels("rejected").inc()
             return False
         raw_mode = all(isinstance(s, _bls.Signature) for s, _, _ in sets)
         for sig, pks, _msg in sets:
             if not pks or sig.is_infinity():
+                _OUTCOMES.with_labels("rejected").inc()
                 return False
             if any(pk.is_infinity() for pk in pks):
+                _OUTCOMES.with_labels("rejected").inc()
                 return False
-        if raw_mode:
-            out = verify_batch_raw_staged(*pack_signature_sets_raw(sets))
-        else:
-            out = verify_batch_hashed(*pack_signature_sets_hashed(sets))
-        return bool(out)
+        path = "raw_staged" if raw_mode else "hashed"
+        impl = fp.get_impl()
+        with tracing.span(
+            "bls.verify_signature_sets", path=path, n_sets=len(sets)
+        ) as sp, _VERIFY_SECONDS.with_labels(path, impl).time():
+            with tracing.span("bls.pack"), _PACK_SECONDS.time():
+                if raw_mode:
+                    args = pack_signature_sets_raw(sets)
+                else:
+                    args = pack_signature_sets_hashed(sets)
+            self._record_geometry(sets, args)
+            if raw_mode:
+                out = bool(verify_batch_raw_staged(*args))
+            else:
+                out = bool(verify_batch_hashed(*args))
+            sp.set(verdict=out)
+        _OUTCOMES.with_labels("ok" if out else "fail").inc()
+        return out
+
+    @staticmethod
+    def _record_geometry(sets, packed_args) -> None:
+        """Batch-geometry accounting: requested vs padded B/K/M lanes and
+        the padding-waste fraction of the pubkey plane (the device pays
+        for padded lanes; the caller only needed the requested ones)."""
+        pk_xy = packed_args[0]
+        b_pad, k_pad = int(pk_xy.shape[0]), int(pk_xy.shape[1])
+        # raw/hashed packers put msg_u [M, 2, 2, NL] at index 4/3
+        m_pad = int(packed_args[4 if len(packed_args) == 8 else 3].shape[0])
+        b_req = len(sets)
+        k_req = max(len(pks) for _, pks, _ in sets)
+        m_req = len({bytes(m) for _, _, m in sets})
+        for dim, req, pad in (
+            ("b", b_req, b_pad), ("k", k_req, k_pad), ("m", m_req, m_pad)
+        ):
+            _LANES.with_labels(dim, "requested").inc(req)
+            _LANES.with_labels(dim, "padded").inc(pad)
+        real_slots = sum(len(pks) for _, pks, _ in sets)
+        _PAD_WASTE.set(1.0 - real_slots / float(b_pad * k_pad))
 
     # -- single-set entry points (same device program, B=1 semantics) ----
 

@@ -67,7 +67,16 @@ from ..state_transition import (
 from ..state_transition.epoch import fork_of
 from ..beacon_chain.pubkey_cache import PubkeyCacheError
 from ..types.containers import FORK_IDS as _FORK_IDS
-from ..utils import metrics
+from ..utils import metrics, tracing
+
+_HTTP_REQS = metrics.counter_vec(
+    "http_api_requests_total",
+    "beacon API requests by method and response code",
+    ("method", "code"),
+)
+_HTTP_SECONDS = metrics.histogram(
+    "http_api_request_seconds", "beacon API request handling wall time"
+)
 
 
 class ApiError(Exception):
@@ -126,17 +135,31 @@ class BeaconApiServer:
         # repeated params join to a comma list (the spec's ?id=1&id=2 and
         # ?id=1,2 forms become equivalent)
         query = {k: ",".join(v) for k, v in parse_qs(url.query).items()}
-        body = None
-        if method == "POST":
-            n = int(req.headers.get("Content-Length") or 0)
-            raw = req.rfile.read(n) if n else b""
-            body = json.loads(raw) if raw else None
+        counted = False  # one request = one http_api_requests_total sample
         try:
+            body = None
+            if method == "POST":
+                n = int(req.headers.get("Content-Length") or 0)
+                raw = req.rfile.read(n) if n else b""
+                try:
+                    body = json.loads(raw) if raw else None
+                except json.JSONDecodeError as e:
+                    # a counted 400, not an uncounted dropped connection
+                    raise ApiError(400, f"malformed JSON body: {e}")
             if url.path == "/eth/v1/events":
                 if method != "GET":
                     raise ApiError(405, "GET only")
-                return self._stream_events(req, query)
-            out = self._route(method, url.path, query, body)
+                # SSE streams until disconnect: counted once it ends
+                # cleanly, never timed; a failure mid-setup falls through
+                # to the 500 accounting below
+                self._stream_events(req, query)
+                _HTTP_REQS.with_labels(method, "200").inc()
+                counted = True
+                return
+            with tracing.span(
+                "http_api.request", method=method, path=url.path
+            ), _HTTP_SECONDS.time():
+                out = self._route(method, url.path, query, body)
             if out is None:
                 payload, ctype = b"", "application/json"
             elif isinstance(out, bytes):
@@ -145,12 +168,19 @@ class BeaconApiServer:
                 payload, ctype = out.encode(), "text/plain; charset=utf-8"
             else:
                 payload, ctype = json.dumps(out).encode(), "application/json"
+            # counted only once the response is fully serialized: a
+            # serialization bug is a 500, a failed write after this point
+            # is the client going away (not re-counted)
+            _HTTP_REQS.with_labels(method, "200").inc()
+            counted = True
             req.send_response(200)
             req.send_header("Content-Type", ctype)
             req.send_header("Content-Length", str(len(payload)))
             req.end_headers()
             req.wfile.write(payload)
         except ApiError as e:
+            if not counted:
+                _HTTP_REQS.with_labels(method, str(e.status)).inc()
             payload = json.dumps(
                 {"code": e.status, "message": e.message}
             ).encode()
@@ -160,6 +190,10 @@ class BeaconApiServer:
             req.end_headers()
             req.wfile.write(payload)
         except Exception as e:  # internal error -> 500 with message
+            # a write failure after the 200 was counted (client went
+            # away) must not re-count the request as a 500
+            if not counted:
+                _HTTP_REQS.with_labels(method, "500").inc()
             payload = json.dumps({"code": 500, "message": repr(e)}).encode()
             try:
                 req.send_response(500)

@@ -146,6 +146,7 @@ def test_staged_verify_b64_matmul_int8(rng):
 
     with device_fp.impl(device_fp.IMPL_MATMUL_INT8):
         jax.clear_caches()
+        device_bls.reset_recompile_tracking()
         try:
             ok = device_bls.verify_batch_raw_staged(
                 *device_bls.pack_signature_sets_raw(
@@ -159,8 +160,56 @@ def test_staged_verify_b64_matmul_int8(rng):
             )
         finally:
             jax.clear_caches()  # never leak int8-traced kernels to others
+            device_bls.reset_recompile_tracking()
     assert bool(ok) is True
     assert bool(bad) is False
+
+
+def test_staged_verify_populates_stage_telemetry(tpu_backend):
+    """ISSUE 2: a staged verify must land per-stage timings in the
+    ``{stage, fp_impl}`` family and tick the recompile counter exactly
+    once per fresh argument-shape signature (the second identical-shape
+    run reuses the jitted program: timings accrue, recompiles don't)."""
+    from lighthouse_tpu.crypto.device import fp as device_fp
+    from lighthouse_tpu.utils import metrics
+
+    stage_vec = metrics.get("bls_device_stage_seconds")
+    recompiles = metrics.get("bls_device_recompiles_total")
+    impl = device_fp.get_impl()
+    stages = ("stage1", "stage2", "stage3")
+
+    sks, pks = _keypairs(1, base=4242)
+    msg = b"\x77" * 32
+    sig = bls.Signature.deserialize(sks[0].sign(msg).serialize())
+    # pad_b=2/k=1/m=1 is a shape no other test uses: fresh to this process
+    args = device_bls.pack_signature_sets_raw(
+        [(sig, [pks[0].point], msg)], pad_b=2, pad_k=1, pad_m=1
+    )
+
+    counts0 = {s: stage_vec.with_labels(s, impl).total for s in stages}
+    rec0 = {s: recompiles.with_labels(s).value for s in stages}
+    assert bool(device_bls.verify_batch_raw_staged(*args)) is True
+    rec1 = {s: recompiles.with_labels(s).value for s in stages}
+    assert bool(device_bls.verify_batch_raw_staged(*args)) is True
+    counts2 = {s: stage_vec.with_labels(s, impl).total for s in stages}
+    rec2 = {s: recompiles.with_labels(s).value for s in stages}
+
+    for s in stages:
+        assert counts2[s] - counts0[s] == 2, (s, counts0, counts2)
+        assert rec1[s] - rec0[s] == 1, (s, rec0, rec1)
+        assert rec2[s] == rec1[s], (s, "second same-shape run recompiled")
+        assert stage_vec.with_labels(s, impl).sum > 0.0
+
+    # the backend path records batch geometry + verdict families and the
+    # whole surface still scrapes cleanly
+    assert bls.verify_signature_sets(
+        [bls.SignatureSet(sig, [pks[0]], msg)]
+    ) is True
+    out = metrics.gather()
+    assert 'bls_device_stage_seconds_bucket{stage="stage1"' in out
+    assert 'bls_device_batch_lanes_total{dim="b",kind="padded"}' in out
+    assert "bls_device_padding_waste_ratio" in out
+    assert 'bls_device_verify_outcomes_total{outcome="ok"}' in out
 
 
 def _non_subgroup_g2() -> G2Point:

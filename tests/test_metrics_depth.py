@@ -95,6 +95,83 @@ def test_sync_families_registered():
         assert family in out, family
 
 
+def test_labeled_family_round_trip():
+    c = metrics.counter_vec(
+        "testm_requests_total", "labeled requests", ("method", "code")
+    )
+    c.with_labels("GET", "200").inc()
+    c.with_labels("GET", "200").inc(2)
+    c.with_labels(method="POST", code="500").inc()
+    # the handle is stable: same labels -> same child
+    assert c.with_labels("GET", "200") is c.labels("GET", "200")
+    assert c.with_labels("GET", "200").value == 3.0
+
+    g = metrics.gauge_vec("testm_depth", "labeled gauge", ("queue",))
+    g.with_labels("attn").set(7)
+    g.with_labels("attn").inc(2)
+    g.with_labels("attn").dec()
+    assert g.with_labels("attn").value == 8.0
+
+    h = metrics.histogram_vec(
+        "testm_lat_seconds", "labeled histogram", ("stage",),
+        buckets=(0.1, 1.0),
+    )
+    h.with_labels("one").observe(0.05)
+    h.with_labels("one").observe(5.0)
+    assert h.with_labels("one").total == 2
+
+    out = metrics.gather()
+    assert 'testm_requests_total{method="GET",code="200"} 3.0' in out
+    assert 'testm_requests_total{method="POST",code="500"} 1.0' in out
+    assert 'testm_depth{queue="attn"} 8.0' in out
+    assert 'testm_lat_seconds_bucket{stage="one",le="0.1"} 1' in out
+    assert 'testm_lat_seconds_bucket{stage="one",le="+Inf"} 2' in out
+    assert 'testm_lat_seconds_count{stage="one"} 2' in out
+
+
+def test_label_cardinality_and_type_conflicts_rejected():
+    v = metrics.counter_vec("testm_strict_total", "strict", ("a", "b"))
+    with pytest.raises(ValueError):
+        v.with_labels("only-one")
+    with pytest.raises(ValueError):
+        v.with_labels(a="x", nope="y")
+    # one name, one type: re-registering under another kind must raise
+    metrics.counter("testm_kind_total", "a counter")
+    with pytest.raises(TypeError):
+        metrics.gauge("testm_kind_total")
+    with pytest.raises(TypeError):
+        metrics.histogram_vec("testm_kind_total", labelnames=("x",))
+    # a vec re-registered with different labelnames must raise too
+    with pytest.raises(ValueError):
+        metrics.counter_vec("testm_strict_total", "strict", ("a",))
+
+
+def test_exposition_escapes_adversarial_label_values():
+    g = metrics.gauge_vec("testm_peer_score", "per-peer", ("peer_id",))
+    evil = 'p\\1"\n# TYPE smuggled counter'
+    g.with_labels(evil).set(1)
+    h = metrics.gauge("testm_evil_help", 'help with \\ and\nnewline')
+    h.set(2)
+    out = metrics.gather()
+    # escaped forms present; raw newline smuggling absent
+    assert '\\\\1\\"\\n# TYPE smuggled counter' in out
+    assert "help with \\\\ and\\nnewline" in out
+    assert "\n# TYPE smuggled counter" not in out
+    # every line still parses
+    metrics.parse_exposition(out)
+
+
+def test_full_exposition_parses_cleanly():
+    # self-contained: register a family of each kind, then parse the
+    # whole registry's exposition
+    metrics.counter_vec("testm_parse_total", "p", ("a",)).with_labels("x").inc()
+    metrics.histogram("testm_parse_seconds", "p").observe(0.1)
+    samples = metrics.parse_exposition(metrics.gather())
+    names = {s[0] for s in samples}
+    assert "testm_parse_total" in names
+    assert "testm_parse_seconds_bucket" in names
+
+
 def test_time_latch_rate_limits(capsys):
     latch = tlog.TimeLatch(window=60.0)
     before = metrics.counter("log_lines_suppressed_total").value

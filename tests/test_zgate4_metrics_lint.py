@@ -1,0 +1,134 @@
+"""Metrics-hygiene gate (ISSUE 2 satellite): the telemetry surface the
+dashboards scrape must stay well-formed as instrumentation accretes.
+
+Checks, against the live process-global registry after importing every
+instrumented hot-path module:
+
+1. every registered family name is snake_case under a known subsystem
+   prefix (new subsystems add their prefix HERE, consciously);
+2. one name = one metric type (the registry enforces it; the gate pins
+   the enforcement);
+3. ``gather()`` output parses cleanly as Prometheus text format — no
+   family, labeled or not, can corrupt the scrape;
+4. a DISABLED trace span costs < 1 microsecond per enter/exit on this
+   box, so hot-path instrumentation can stay always-on.
+
+Named ``test_zgate4_*`` so it sorts after the functional suite inside
+the tier-1 wall-clock window (see tests/conftest.py discipline).
+"""
+
+import re
+import time
+
+import pytest
+
+from lighthouse_tpu.utils import metrics, tracing
+
+# One prefix per subsystem; adding a family under a new prefix means
+# adding it here with a matching entry in docs/OBSERVABILITY.md.
+KNOWN_PREFIXES = (
+    "attestation_",
+    "beacon_block_",
+    "beacon_processor_",
+    "block_",
+    "bls_device_",
+    "head_",
+    "http_api_",
+    "log_",
+    "network_",
+    "op_pool_",
+    "slasher_",
+    "store_",
+    "sync_",
+    "testm_",  # test-only families from tests/test_metrics_depth.py
+    "validator_monitor_",
+    "vc_",
+)
+
+_NAME = re.compile(r"[a-z][a-z0-9_]*$")
+
+
+def _import_instrumented_modules():
+    """Every module that registers hot-path families (network/vc modules
+    need the absent ``cryptography`` dep, so their families are asserted
+    by test_metrics_depth instead)."""
+    import lighthouse_tpu.beacon_chain.attestation_verification  # noqa: F401
+    import lighthouse_tpu.beacon_chain.block_verification  # noqa: F401
+    import lighthouse_tpu.beacon_processor.processor  # noqa: F401
+    import lighthouse_tpu.crypto.device.bls  # noqa: F401
+    import lighthouse_tpu.http_api.server  # noqa: F401
+    import lighthouse_tpu.utils.logging  # noqa: F401
+
+
+def test_registered_names_snake_case_with_known_prefix():
+    _import_instrumented_modules()
+    reg = metrics.registry_snapshot()
+    assert reg, "registry must not be empty after imports"
+    for name in reg:
+        assert _NAME.match(name), f"metric name not snake_case: {name!r}"
+        assert name.startswith(KNOWN_PREFIXES), (
+            f"metric {name!r} has no known subsystem prefix; add the "
+            f"prefix to KNOWN_PREFIXES and document the family in "
+            f"docs/OBSERVABILITY.md"
+        )
+
+
+def test_one_name_one_type_enforced():
+    _import_instrumented_modules()
+    # log_lines_total is a Counter (utils/logging.py); any re-registration
+    # under another type must raise, not silently alias
+    with pytest.raises(TypeError):
+        metrics.gauge("log_lines_total")
+    with pytest.raises(TypeError):
+        metrics.histogram_vec("log_lines_total", labelnames=("x",))
+    # and a family is never registered under two types already
+    kinds = {}
+    for name, m in metrics.registry_snapshot().items():
+        assert name not in kinds
+        kinds[name] = m.kind
+        assert m.kind in ("counter", "gauge", "histogram"), (name, m.kind)
+
+
+def test_gather_parses_cleanly():
+    _import_instrumented_modules()
+    out = metrics.gather()
+    # the shared grammar (metrics.parse_exposition) raises on any
+    # malformed sample line
+    samples = metrics.parse_exposition(out)
+    assert samples
+    seen_help, seen_type = set(), set()
+    for line in out.splitlines():
+        if line.startswith("# HELP "):
+            seen_help.add(line.split(" ", 3)[2])
+        elif line.startswith("# TYPE "):
+            seen_type.add(line.split(" ", 3)[2])
+    # samples only appear under their family's HELP/TYPE headers
+    for name, _labels, _value in samples:
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if base.endswith(suffix) and base[: -len(suffix)] in seen_type:
+                base = base[: -len(suffix)]
+                break
+        assert base in seen_type and base in seen_help, name
+
+
+def test_disabled_span_costs_under_one_microsecond():
+    was = tracing.enabled()
+    tracing.disable()
+    try:
+        n = 20_000
+        span = tracing.span  # the hot-path spelling caches the lookup too
+        best = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                with span("zgate4.noop"):
+                    pass
+            best = min(best, (time.perf_counter() - t0) / n)
+        assert best < 1e-6, (
+            f"disabled span enter/exit costs {best * 1e9:.0f} ns — too "
+            f"expensive to leave always-on in the verification hot path"
+        )
+    finally:
+        if was:
+            tracing.enable()

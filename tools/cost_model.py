@@ -236,6 +236,11 @@ def main() -> None:
       "achieved on one CPU core-ish — the right order for scalar int32 "
       "code, which says the lane count above is the true work, not "
       "padding waste.")
+    w("- Measured counterpart: the `bls_device_stage_seconds` histogram "
+      "family labeled `{stage, fp_impl}` (scraped at `/metrics`, surfaced "
+      "as `stage_latency` in the bench JSON) gives the observed per-stage "
+      "split to hold against this model — see "
+      "[OBSERVABILITY.md](OBSERVABILITY.md).")
     w("")
     out = REPO / "docs" / "COST_MODEL.md"
     out.write_text("\n".join(lines) + "\n")

@@ -1,0 +1,108 @@
+"""Chrome-trace report for the staged device BLS verifier.
+
+Runs one (or more) staged ``verify_signature_sets`` batches with the
+span subsystem enabled and writes a chrome://tracing JSON — open it at
+``chrome://tracing`` or https://ui.perfetto.dev to see where the
+gossip-to-verdict wall-clock goes (pack vs stage1/2/3 dispatch+sync vs
+verdict host-sync), per thread. Also prints the per-stage p50/p99 from
+the ``bls_device_stage_seconds`` histogram family so the trace and the
+scrape can be cross-checked.
+
+Usage (off-TPU boxes want the CPU platform pinned so a dead TPU tunnel
+cannot hang the report):
+
+    JAX_PLATFORMS=cpu python tools/trace_report.py -o /tmp/bls_trace.json
+    python tools/trace_report.py --cpu --sets 6 --committee 4 --reps 2
+
+The first rep includes jit compile (visible as the long stage spans);
+pass ``--reps 2`` to also capture warm-cache dispatches.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_sets(n_sets: int, committee: int, n_msgs: int):
+    """Small raw workload: ``(lazy compressed Signature, [pk points],
+    message)`` triples, the shape ``TpuBackend.verify_signature_sets``
+    routes to the staged device program."""
+    from lighthouse_tpu.crypto import bls
+
+    sks = [bls.SecretKey(7_000 + i) for i in range(committee)]
+    pks = [sk.public_key().point for sk in sks]
+    msgs = [bytes([m + 1]) * 32 for m in range(n_msgs)]
+    sets = []
+    for i in range(n_sets):
+        m = msgs[i % n_msgs]
+        agg = bls.AggregateSignature.infinity()
+        for sk in sks:
+            agg.add_assign(sk.sign(m))
+        sets.append(
+            (bls.Signature.deserialize(agg.serialize()), list(pks), m)
+        )
+    return sets
+
+
+def stage_quantile_summary() -> dict:
+    """{stage: {fp_impl, p50_s, p99_s, mean_s, count}} from the metric
+    family the verifier populates (docs/OBSERVABILITY.md)."""
+    from lighthouse_tpu.crypto.device.bls import stage_latency_summary
+
+    return stage_latency_summary()
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("-o", "--out", default="/tmp/bls_trace.json",
+                    help="chrome trace output path")
+    ap.add_argument("--sets", type=int, default=4)
+    ap.add_argument("--committee", type=int, default=2)
+    ap.add_argument("--msgs", type=int, default=2)
+    ap.add_argument("--reps", type=int, default=1,
+                    help="verify repetitions (first includes compile)")
+    ap.add_argument("--cpu", action="store_true",
+                    help="pin JAX_PLATFORMS=cpu before importing jax")
+    args = ap.parse_args(argv)
+    if args.reps < 1:
+        ap.error("--reps must be >= 1")
+
+    if args.cpu:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+    from lighthouse_tpu.utils import tracing
+
+    tracing.enable()
+    tracing.clear()
+
+    from lighthouse_tpu.crypto.device.bls import TpuBackend
+
+    sets = build_sets(args.sets, args.committee, args.msgs)
+    backend = TpuBackend()
+    with tracing.span("trace_report.run", reps=args.reps):
+        for rep in range(args.reps):
+            with tracing.span("trace_report.rep", rep=rep):
+                ok = backend.verify_signature_sets(sets)
+    assert ok is True, "trace workload must verify"
+
+    n = tracing.export_chrome(args.out)
+    print(
+        json.dumps(
+            {
+                "trace": args.out,
+                "events": n,
+                "dropped": tracing.dropped(),
+                "verdict": bool(ok),
+                "stage_latency": stage_quantile_summary(),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
