@@ -22,7 +22,7 @@ from ..state_transition.signature_sets import (
     aggregate_and_proof_sets,
     indexed_attestation_set,
 )
-from ..utils import metrics, tracing
+from ..utils import flight_recorder, metrics, tracing
 
 ATTESTATION_PROPAGATION_SLOT_RANGE = 32
 TARGET_AGGREGATORS_PER_COMMITTEE = 16
@@ -50,11 +50,35 @@ _OUTCOMES = metrics.counter_vec(
 )
 
 
-def _count_outcomes(kind: str, results) -> None:
-    for r in results:
-        _OUTCOMES.with_labels(
-            kind, r.kind if isinstance(r, AttestationError) else "ok"
-        ).inc()
+def _att_data(kind: str, item):
+    """The AttestationData of a gossip item, whichever wrapper it wears."""
+    return item.data if kind == "unaggregated" else item.message.aggregate.data
+
+
+def _record_rejection(kind: str, e: "AttestationError", item) -> None:
+    """Journal one ``attestation_rejected`` event: reason + slot/root (+
+    the validator/aggregator index when the raise site knew it)."""
+    try:
+        data = _att_data(kind, item)
+        where = {
+            "slot": int(data.slot),
+            "committee_index": int(data.index),
+            "root": bytes(data.beacon_block_root),
+        }
+    except Exception:  # malformed item: the reason is still worth keeping
+        where = {}
+    flight_recorder.record(
+        "attestation_rejected", kind=kind, reason=e.kind, **e.ctx, **where
+    )
+
+
+def _count_outcomes(kind: str, results, items) -> None:
+    for r, item in zip(results, items):
+        if isinstance(r, AttestationError):
+            _OUTCOMES.with_labels(kind, r.kind).inc()
+            _record_rejection(kind, r, item)
+        else:
+            _OUTCOMES.with_labels(kind, "ok").inc()
 
 
 def _observed(kind: str):
@@ -71,6 +95,7 @@ def _observed(kind: str):
                     out = fn(chain, item, current_slot)
                 except AttestationError as e:
                     _OUTCOMES.with_labels(kind, e.kind).inc()
+                    _record_rejection(kind, e, item)
                     raise
                 _OUTCOMES.with_labels(kind, "ok").inc()
                 return out
@@ -80,11 +105,14 @@ def _observed(kind: str):
 
 class AttestationError(ValueError):
     """Structural/gossip-rule rejection; ``kind`` mirrors the reference's
-    error enum so batch fallback can report per-item outcomes."""
+    error enum so batch fallback can report per-item outcomes. ``ctx``
+    carries whatever identifying context the raise site had (validator
+    index, aggregator index) for the flight-recorder journal."""
 
-    def __init__(self, kind: str, detail: str = ""):
+    def __init__(self, kind: str, detail: str = "", **ctx):
         super().__init__(f"{kind}{': ' + detail if detail else ''}")
         self.kind = kind
+        self.ctx = ctx
 
 
 @dataclass
@@ -136,7 +164,10 @@ def _structural_unaggregated(chain, att, current_slot: int):
         )
     validator_index = int(committee[bits.index(True)])
     if chain.observed_attesters.is_known(validator_index, data.target.epoch):
-        raise AttestationError("PriorAttestationKnown", str(validator_index))
+        raise AttestationError(
+            "PriorAttestationKnown", str(validator_index),
+            validator_index=validator_index,
+        )
     t = chain.types
     indexed = t.IndexedAttestation(
         attesting_indices=[validator_index], data=data, signature=att.signature
@@ -172,7 +203,9 @@ def verify_unaggregated_attestation(chain, att, current_slot: int):
         raise AttestationError("InvalidSignature")
     with chain._chain_lock:
         if chain.observed_attesters.observe(validator_index, att.data.target.epoch):
-            raise AttestationError("PriorAttestationKnown")
+            raise AttestationError(
+                "PriorAttestationKnown", validator_index=validator_index
+            )
     return VerifiedUnaggregatedAttestation(att, indexed, validator_index, att.data.index)
 
 
@@ -221,14 +254,18 @@ def batch_verify_unaggregated_attestations(chain, attestations, current_slot: in
                     # any item was observed); reject it exactly as the
                     # sequential path would.
                     if chain.observed_attesters.observe(vindex, att.data.target.epoch):
-                        results[pos] = AttestationError("PriorAttestationKnown")
+                        results[pos] = AttestationError(
+                            "PriorAttestationKnown", validator_index=vindex
+                        )
                     else:
                         results[pos] = VerifiedUnaggregatedAttestation(
                             att, indexed, vindex, att.data.index
                         )
                 else:
-                    results[pos] = AttestationError("InvalidSignature")
-    _count_outcomes("unaggregated", results)
+                    results[pos] = AttestationError(
+                        "InvalidSignature", validator_index=vindex
+                    )
+    _count_outcomes("unaggregated", results, attestations)
     return results
 
 
@@ -254,7 +291,9 @@ def _structural_aggregated(chain, signed_agg, current_slot: int):
     if chain.observed_aggregates.is_known(att_root, data.slot):
         raise AttestationError("AttestationAlreadyKnown")
     if chain.observed_aggregators.is_known(msg.aggregator_index, data.target.epoch):
-        raise AttestationError("AggregatorAlreadyKnown")
+        raise AttestationError(
+            "AggregatorAlreadyKnown", aggregator_index=int(msg.aggregator_index)
+        )
     if not chain.fork_choice.proto.contains(bytes(data.beacon_block_root)):
         raise AttestationError("UnknownHeadBlock")
     if not chain.fork_choice.proto.contains(bytes(data.target.root)):
@@ -322,7 +361,7 @@ def batch_verify_aggregated_attestations(chain, signed_aggs, current_slot: int):
         _batch_verify_aggregated_inner(
             chain, signed_aggs, current_slot, results, pending
         )
-    _count_outcomes("aggregate", results)
+    _count_outcomes("aggregate", results, signed_aggs)
     return results
 
 
@@ -366,11 +405,17 @@ def _batch_verify_aggregated_inner(
                 elif chain.observed_aggregators.observe(
                     msg.aggregator_index, msg.aggregate.data.target.epoch
                 ):
-                    results[pos] = AttestationError("AggregatorAlreadyKnown")
+                    results[pos] = AttestationError(
+                        "AggregatorAlreadyKnown",
+                        aggregator_index=int(msg.aggregator_index),
+                    )
                 else:
                     results[pos] = VerifiedAggregatedAttestation(
                         sa, indexed, msg.aggregator_index
                     )
             else:
-                results[pos] = AttestationError("InvalidSignature")
+                results[pos] = AttestationError(
+                    "InvalidSignature",
+                    aggregator_index=int(sa.message.aggregator_index),
+                )
     return results

@@ -24,7 +24,7 @@ from ..state_transition import (
 )
 from ..state_transition.epoch import fork_of
 from ..state_transition.signature_sets import block_proposal_set
-from ..utils import metrics, tracing
+from ..utils import flight_recorder, metrics, tracing
 
 _STAGE_SECONDS = metrics.histogram_vec(
     "beacon_block_verification_seconds",
@@ -43,6 +43,25 @@ class BlockError(ValueError):
     def __init__(self, kind: str, detail: str = ""):
         super().__init__(f"{kind}{': ' + detail if detail else ''}")
         self.kind = kind
+        self.detail = detail
+
+
+def _record_rejection(stage: str, e: BlockError, signed_block, block_root=None):
+    """Journal one ``block_rejected`` event with the forensic context a
+    counter tick loses: stage, reason, slot, proposer and root."""
+    if not flight_recorder.enabled():
+        # the root below may need a full SSZ hash: never pay it (bursts
+        # of duplicate-gossip rejections) when nothing is recording
+        return
+    block = signed_block.message
+    if block_root is None:
+        block_root = hash_tree_root(block)
+    flight_recorder.record(
+        "block_rejected",
+        stage=stage, reason=e.kind, detail=e.detail,
+        slot=int(block.slot), proposer_index=int(block.proposer_index),
+        root=bytes(block_root),
+    )
 
 
 @dataclass
@@ -63,14 +82,28 @@ class GossipVerifiedBlock:
                 out = cls._new_inner(chain, signed_block)
             except BlockError as e:
                 _OUTCOMES.with_labels("gossip", e.kind).inc()
+                _record_rejection(
+                    "gossip", e, signed_block,
+                    getattr(e, "block_root", None),
+                )
                 raise
             _OUTCOMES.with_labels("gossip", "ok").inc()
             return out
 
     @classmethod
     def _new_inner(cls, chain, signed_block):
+        block_root = hash_tree_root(signed_block.message)
+        try:
+            return cls._new_checked(chain, signed_block, block_root)
+        except BlockError as e:
+            # forensics reuses THIS Merkleization: a flood of junk gossip
+            # blocks must not pay a second full SSZ hash per rejection
+            e.block_root = block_root
+            raise
+
+    @classmethod
+    def _new_checked(cls, chain, signed_block, block_root):
         block = signed_block.message
-        block_root = hash_tree_root(block)
         current_slot = chain.slot()
 
         if block.slot > current_slot:
@@ -166,7 +199,16 @@ class SignatureVerifiedBlock:
             "signature", "ok" if ok else "InvalidSignature"
         ).inc()
         if not ok:
-            raise BlockError("InvalidSignature")
+            e = BlockError("InvalidSignature")
+            _record_rejection("signature", e, signed_block, block_root)
+            # a full-block signature batch failing is the verify-failure
+            # the forensics layer exists for: snapshot the journal (the
+            # staged device event with per-stage latencies is in it)
+            flight_recorder.dump_on_failure(
+                "block_signature_invalid",
+                slot=int(signed_block.message.slot), root=bytes(block_root),
+            )
+            raise e
         return cls(signed_block, block_root, state, skip_proposal)
 
 

@@ -23,6 +23,7 @@ from ..types.chain_spec import (
     DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF,
 )
 from ..types.domains import compute_signing_root, get_domain
+from ..utils import flight_recorder
 
 TARGET_AGGREGATORS_PER_SYNC_SUBCOMMITTEE = 16
 
@@ -202,6 +203,14 @@ def batch_verify_sync_committee_messages(chain, messages):
                 )
             else:
                 results[pos] = VerifiedSyncCommitteeMessage(m, positions)
+    for pos, r in enumerate(results):
+        if isinstance(r, SyncCommitteeError):
+            m = messages[pos]
+            flight_recorder.record(
+                "sync_rejected", kind="message", reason=r.kind,
+                slot=int(m.slot), validator_index=int(m.validator_index),
+                root=bytes(m.beacon_block_root),
+            )
     return results
 
 
@@ -216,7 +225,22 @@ def verify_sync_committee_message(chain, msg) -> VerifiedSyncCommitteeMessage:
 
 def verify_sync_contribution(chain, signed) -> VerifiedSyncContribution:
     """SignedContributionAndProof from gossip/API — three signature sets
-    in one backend call (reference ``:252-267``)."""
+    in one backend call (reference ``:252-267``). Rejections are
+    journaled as ``sync_rejected`` events with slot/aggregator context."""
+    try:
+        return _verify_sync_contribution_inner(chain, signed)
+    except SyncCommitteeError as e:
+        c = signed.message.contribution
+        flight_recorder.record(
+            "sync_rejected", kind="contribution", reason=e.kind,
+            slot=int(c.slot), subcommittee_index=int(c.subcommittee_index),
+            aggregator_index=int(signed.message.aggregator_index),
+            root=bytes(c.beacon_block_root),
+        )
+        raise
+
+
+def _verify_sync_contribution_inner(chain, signed) -> VerifiedSyncContribution:
     msg = signed.message
     contribution = msg.contribution
     slot = int(contribution.slot)

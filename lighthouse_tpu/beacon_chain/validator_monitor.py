@@ -9,11 +9,20 @@ from __future__ import annotations
 import threading
 from dataclasses import dataclass, field
 
-from ..utils import metrics
+from ..utils import flight_recorder, logging, metrics
 
 _MONITORED = metrics.gauge(
     "validator_monitor_validators", "number of monitored validators"
 )
+_FAILURES = metrics.counter_vec(
+    "validator_monitor_failures_total",
+    "monitored validators' rejected gossip objects, by kind and reason "
+    "(fed by the flight-recorder rejection events)",
+    ("kind", "reason"),
+)
+# a rejection storm against a monitored validator is one page, not a
+# log line per event (the journal + counter keep the full count)
+_FAIL_LATCH = logging.TimeLatch(10.0)
 _ATT_HITS = metrics.counter(
     "validator_monitor_attestation_in_block_total",
     "monitored validators' attestations observed in imported blocks",
@@ -34,6 +43,9 @@ class ValidatorRecord:
     index: int
     attestations_included: int = 0
     blocks_proposed: int = 0
+    attestations_failed: int = 0
+    blocks_failed: int = 0
+    last_failure_reason: str | None = None
     last_attestation_slot: int | None = None
     last_inclusion_delay: int | None = None
     missed_epochs: set = field(default_factory=set)
@@ -48,6 +60,59 @@ class ValidatorMonitor:
         self.auto = auto
         self._records: dict[int, ValidatorRecord] = {}
         self._lock = threading.Lock()
+        self._attached = False
+
+    # -- flight-recorder wiring -------------------------------------------
+
+    def attach(self) -> "ValidatorMonitor":
+        """Subscribe to the flight-recorder journal: ``attestation_rejected``
+        and ``block_rejected`` events for monitored validators become
+        ``validator_monitor_failures_total{kind, reason}`` ticks, per-record
+        failure counts, and a warn log — a monitored validator failing to
+        land work is an operator page, not just an anonymous counter."""
+        if not self._attached:
+            flight_recorder.subscribe(self._on_event)
+            self._attached = True
+        return self
+
+    def detach(self) -> None:
+        if self._attached:
+            flight_recorder.unsubscribe(self._on_event)
+            self._attached = False
+
+    def _on_event(self, ev: dict) -> None:
+        kind = ev.get("kind")
+        fields = ev.get("fields") or {}
+        if kind == "attestation_rejected":
+            index = fields.get("validator_index", fields.get("aggregator_index"))
+            failure = "attestation"
+        elif kind == "block_rejected":
+            index = fields.get("proposer_index")
+            failure = "block"
+        else:
+            return
+        if index is None:
+            return  # rejection happened before an index was known
+        reason = fields.get("reason", "unknown")
+        with self._lock:
+            # observe-only, even in auto mode: a rejection can carry an
+            # ATTACKER-SUPPLIED index (e.g. a bogus proposer_index on a
+            # junk gossip block) — only indices already registered (or
+            # auto-registered from VALIDATED imports) may grow state
+            rec = self._records.get(int(index))
+            if rec is None:
+                return  # not monitored
+            if failure == "attestation":
+                rec.attestations_failed += 1
+            else:
+                rec.blocks_failed += 1
+            rec.last_failure_reason = reason
+        _FAILURES.with_labels(failure, reason).inc()
+        logging.rate_limited(
+            _FAIL_LATCH, "warn", f"monitored validator {failure} rejected",
+            validator_index=int(index), reason=reason,
+            slot=fields.get("slot"),
+        )
 
     def add_validator(self, index: int) -> None:
         with self._lock:
@@ -113,6 +178,9 @@ class ValidatorMonitor:
                     "index": r.index,
                     "attestations_included": r.attestations_included,
                     "blocks_proposed": r.blocks_proposed,
+                    "attestations_failed": r.attestations_failed,
+                    "blocks_failed": r.blocks_failed,
+                    "last_failure_reason": r.last_failure_reason,
                     "last_attestation_slot": r.last_attestation_slot,
                     "last_inclusion_delay": r.last_inclusion_delay,
                     "missed_epochs": sorted(r.missed_epochs),

@@ -10,7 +10,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
-from ..utils import logging, metrics, tracing
+from ..utils import flight_recorder, logging, metrics, tracing
 
 _QUEUE_LEN = metrics.gauge("beacon_processor_queue_total", "queued work items")
 _WORK_TOTAL = metrics.counter_vec(
@@ -120,6 +120,11 @@ class BeaconProcessor:
             q = self._queues[work.kind]
             if len(q) >= self.queue_bounds[work.kind]:
                 _DROPPED.inc()
+                flight_recorder.record(
+                    "queue_shed", kind=work.kind.name, queue_len=len(q),
+                    bound=self.queue_bounds[work.kind],
+                    total_queued=sum(len(x) for x in self._queues.values()),
+                )
                 logging.rate_limited(
                     _SHED_LATCH, "warn", "work queue full, shedding",
                     kind=work.kind.name,

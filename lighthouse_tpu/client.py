@@ -68,6 +68,9 @@ class Client:
             self._stop.set()
             if self.api is not None:
                 self.api.stop()
+            monitor = getattr(self.chain, "validator_monitor", None)
+            if monitor is not None:
+                monitor.detach()  # stop feeding a dead client's monitor
             self.processor.shutdown()
             self.persist()
             if self.monitoring is not None:
@@ -292,7 +295,7 @@ class ClientBuilder:
             if isinstance(cfg.monitor_validators, (list, tuple, set)):
                 for i in cfg.monitor_validators:
                     monitor.add_validator(int(i))
-            chain.validator_monitor = monitor
+            chain.validator_monitor = monitor.attach()
         # checkpoint sync: store the anchor block so lookups resolve and
         # backfill has a starting parent
         cp_block = getattr(self, "_checkpoint_block", None)
@@ -453,7 +456,7 @@ def _build_processor(chain, n_workers: int) -> BeaconProcessor:
             chain.op_pool.insert_sync_contribution(item.message.contribution)
         return v
 
-    return BeaconProcessor(
+    processor = BeaconProcessor(
         {
             WorkKind.GOSSIP_ATTESTATION: on_attestation_batch,
             WorkKind.GOSSIP_AGGREGATE: on_aggregate_batch,
@@ -464,6 +467,9 @@ def _build_processor(chain, n_workers: int) -> BeaconProcessor:
         },
         n_workers=n_workers,
     )
+    # the /lighthouse/health surface reads queue depths off the chain
+    chain.beacon_processor = processor
+    return processor
 
 
 def _slot_timer(chain, clock, stop: threading.Event) -> None:

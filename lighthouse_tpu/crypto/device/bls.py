@@ -40,7 +40,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ...utils import metrics, tracing
+from ...utils import flight_recorder, metrics, tracing
 from ..params import DST, G1_X, G1_Y, P, R, X
 from ..cpu.pairing import PSI_CX, PSI_CY
 from ..cpu.hash_to_curve import hash_to_g2
@@ -442,7 +442,8 @@ def reset_recompile_tracking() -> None:
 def _run_stage(stage: str, fn, *args):
     """One staged dispatch: recompile accounting keyed on the argument
     (shape, dtype, fp_impl) signature, span + labeled wall-time histogram
-    closed at the device sync boundary."""
+    closed at the device sync boundary. Returns ``(out, elapsed_s,
+    fresh)`` so the caller can journal per-stage attribution."""
     impl = fp.get_impl()
     key = (
         stage,
@@ -452,9 +453,8 @@ def _run_stage(stage: str, fn, *args):
     with tracing.span(f"bls.{stage}", fp_impl=impl):
         t0 = time.perf_counter()
         out = jax.block_until_ready(fn(*args))
-        _STAGE_SECONDS.with_labels(stage, impl).observe(
-            time.perf_counter() - t0
-        )
+        elapsed = time.perf_counter() - t0
+        _STAGE_SECONDS.with_labels(stage, impl).observe(elapsed)
     # seen only after a SUCCESSFUL dispatch: a failed first compile must
     # not consume the signature's fresh tick (the retry pays the compile)
     with _seen_lock:
@@ -463,7 +463,7 @@ def _run_stage(stage: str, fn, *args):
             _seen_stage_shapes.add(key)
     if fresh:
         _RECOMPILES.with_labels(stage).inc()
-    return out
+    return out, elapsed, fresh
 
 
 def stage_latency_summary(impl: str | None = None) -> dict:
@@ -500,23 +500,43 @@ def verify_batch_raw_staged(
     pk_xy, pk_mask, sig_x, sig_larger, msg_u, msg_idx, rand_bits, set_mask
 ):
     """Staged equivalent of ``verify_batch_raw`` (same inputs, same
-    verdict): three device dispatches, intermediates stay on device."""
-    sig_xy, mx, my, minf, sig_ok = _run_stage(
+    verdict): three device dispatches, intermediates stay on device.
+    Each call journals one ``bls_stage_verify`` flight-recorder event
+    (batch geometry, fp_impl, per-stage dispatch-to-sync seconds,
+    verdict, recompile flag); a False verdict triggers
+    ``dump_on_failure`` so the surrounding context is preserved."""
+    (sig_xy, mx, my, minf, sig_ok), s1, f1 = _run_stage(
         "stage1", _stage1, sig_x, sig_larger, msg_u
     )
-    outs = _run_stage(
+    outs, s2, f2 = _run_stage(
         "stage2", _stage2, pk_xy, pk_mask, sig_xy, rand_bits, set_mask
     )
     pk_x, pk_y, pk_inf, acc_x, acc_y, acc_inf, flags_ok = outs
     msg_aff_x = jnp.take(mx, msg_idx, axis=0)
     msg_aff_y = jnp.take(my, msg_idx, axis=0)
     msg_aff_inf = jnp.take(minf, msg_idx, axis=0)
-    pair_ok = _run_stage(
+    pair_ok, s3, f3 = _run_stage(
         "stage3", _stage3,
         pk_x, pk_y, pk_inf, msg_aff_x, msg_aff_y, msg_aff_inf,
         acc_x, acc_y, acc_inf,
     )
-    return pair_ok & flags_ok & jnp.all(sig_ok | ~set_mask)
+    out = pair_ok & flags_ok & jnp.all(sig_ok | ~set_mask)
+    # every stage output is already synced, so the verdict read is free
+    verdict = bool(out)
+    geometry = {
+        "b": int(pk_xy.shape[0]),
+        "k": int(pk_xy.shape[1]),
+        "m": int(msg_u.shape[0]),
+        "fp_impl": fp.get_impl(),
+    }
+    flight_recorder.record(
+        "bls_stage_verify",
+        stage1_s=round(s1, 6), stage2_s=round(s2, 6), stage3_s=round(s3, 6),
+        recompiled=bool(f1 or f2 or f3), verdict=verdict, **geometry,
+    )
+    if not verdict:
+        flight_recorder.dump_on_failure("stage_verify_failure", **geometry)
+    return out
 
 
 # ---------------------------------------------------------------------------

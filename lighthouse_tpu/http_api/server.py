@@ -378,11 +378,57 @@ class BeaconApiServer:
         if path == "/metrics":
             return metrics.gather()
         if path == "/lighthouse/health":
-            # node-local host stats (reference common/system_health via the
-            # lighthouse-specific API namespace)
-            from ..utils import system_health
+            # ONE consolidated node-health document (reference: the
+            # lighthouse-specific API namespace pulls common/system_health
+            # + monitoring_api process/beacon data): host stats, process
+            # + beacon-node state, beacon-processor queue depths, peer
+            # counts and the flight recorder's own status — the page an
+            # operator reads first when the node misbehaves.
+            from ..utils import flight_recorder, monitoring, system_health
 
-            return {"data": system_health.observe()}
+            doc = {"system": system_health.observe()}
+            try:
+                doc.update(monitoring.collect(chain))
+            except Exception as e:  # a degraded chain still reports hosts
+                doc["collect_error"] = repr(e)
+            proc = getattr(chain, "beacon_processor", None)
+            doc["beacon_processor"] = (
+                None
+                if proc is None
+                else {
+                    "queues": proc.queue_lengths(),
+                    "dropped_total": metrics.get(
+                        "beacon_processor_dropped_total"
+                    ).value,
+                }
+            )
+            # derived from the collected doc: one transport read, one
+            # fact — and UNKNOWN (null) when collect failed, never a
+            # fabricated "0 peers" on the page operators read first
+            bn = doc.get("beacon_node")
+            doc["network"] = (
+                None if bn is None else {"peer_count": bn.get("peers", 0)}
+            )
+            doc["flight_recorder"] = flight_recorder.status()
+            return {"data": doc}
+        if path == "/lighthouse/flight_recorder":
+            # live journal tail: ?kind=a,b filters, ?limit=N bounds the
+            # reply (newest events win); recorder status rides along
+            from ..utils import flight_recorder
+
+            kinds = None
+            if "kind" in query:
+                kinds = [k for k in query["kind"].split(",") if k]
+            try:
+                limit = int(query.get("limit", "256"))
+            except ValueError:
+                raise ApiError(400, "malformed limit parameter")
+            return {
+                "data": {
+                    **flight_recorder.status(),
+                    "events": flight_recorder.events(kinds=kinds, limit=limit),
+                }
+            }
 
 
         m = re.fullmatch(r"/eth/v1/beacon/states/([^/]+)/root", path)

@@ -16,7 +16,7 @@ from __future__ import annotations
 import threading
 import time
 
-from ..utils import logging, metrics
+from ..utils import flight_recorder, logging, metrics
 
 _PENALTIES = metrics.counter(
     "network_peer_penalties_total", "scoring penalties applied"
@@ -191,11 +191,19 @@ class PeerManager:
         st.decay()
         st.score += OFFENCES[offence]
         _PENALTIES.inc()
+        flight_recorder.record(
+            "peer_penalty", peer=self.ban_key(peer), offence=offence,
+            score=round(st.score, 3),
+        )
         if st.score <= BAN_THRESHOLD:
             key = self.ban_key(peer)
             if key and key not in self._banned:
                 self._banned[key] = time.monotonic() + BAN_DURATION_S
                 _BANS.inc()
+                flight_recorder.record(
+                    "peer_ban", peer=key, score=round(st.score, 3),
+                    offence=offence, duration_s=BAN_DURATION_S,
+                )
                 logging.log("warn", "peer banned", peer=key,
                             score=st.score, offence=offence)
         if st.score <= DISCONNECT_THRESHOLD:

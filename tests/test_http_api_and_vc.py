@@ -173,6 +173,77 @@ def test_sse_events_stream(node):
     assert any(e.startswith("data:") and '"block"' in e for e in events), events
 
 
+def test_flight_recorder_and_health_endpoints(node):
+    """ISSUE 3: the journal tail is live-readable at
+    /lighthouse/flight_recorder (filterable) and /lighthouse/health is
+    ONE consolidated document (host + process + beacon node + processor
+    queues + peers + recorder status)."""
+    import json as _json
+    import urllib.request
+
+    from lighthouse_tpu.utils import flight_recorder as fr
+
+    h, chain, clock, server = node
+    base = f"http://127.0.0.1:{server.port}"
+    prev = fr.configure(enabled=True)
+    fr.record("queue_shed", kind="GOSSIP_ATTESTATION", queue_len=9, bound=9)
+    fr.record("peer_penalty", peer="deadbeef", offence="rate_limit", score=-2.0)
+    try:
+        with urllib.request.urlopen(
+            base + "/lighthouse/flight_recorder?kind=queue_shed&limit=5",
+            timeout=5,
+        ) as r:
+            doc = _json.load(r)["data"]
+        assert doc["enabled"] is True
+        assert doc["recorded_total"] >= 2
+        assert doc["events"], "filtered journal tail must not be empty"
+        assert all(e["kind"] == "queue_shed" for e in doc["events"])
+        assert len(doc["events"]) <= 5
+        assert doc["events"][-1]["fields"]["queue_len"] == 9
+
+        # malformed limit is a 400, not a 500
+        import urllib.error as _err
+
+        with pytest.raises(_err.HTTPError) as e:
+            urllib.request.urlopen(
+                base + "/lighthouse/flight_recorder?limit=abc", timeout=5
+            )
+        assert e.value.code == 400
+
+        with urllib.request.urlopen(base + "/lighthouse/health", timeout=5) as r:
+            health = _json.load(r)["data"]
+        assert health["system"]["system_cpu_count"] >= 1
+        assert health["process"]["pid"] > 0
+        assert health["beacon_node"]["head_slot"] == int(chain.head_state.slot)
+        assert health["beacon_node"]["peers"] == 0
+        assert health["network"] == {"peer_count": 0}
+        # no processor attached to this bare test chain: explicit null
+        assert health["beacon_processor"] is None
+        assert health["flight_recorder"]["recorded_total"] >= 2
+
+        # with a processor attached, queue depths appear per kind
+        from lighthouse_tpu.beacon_processor.processor import (
+            BeaconProcessor, WorkKind,
+        )
+
+        proc = BeaconProcessor(handlers={}, n_workers=0)
+        chain.beacon_processor = proc
+        try:
+            with urllib.request.urlopen(
+                base + "/lighthouse/health", timeout=5
+            ) as r:
+                health = _json.load(r)["data"]
+            assert health["beacon_processor"]["queues"] == {
+                k.name: 0 for k in WorkKind
+            }
+        finally:
+            chain.beacon_processor = None
+            proc.shutdown()
+    finally:
+        fr.configure(**prev)
+        fr.clear()
+
+
 def test_committees_identity_and_light_client_routes(node):
     import urllib.request
     import urllib.error

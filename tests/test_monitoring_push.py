@@ -1,0 +1,122 @@
+"""Remote monitoring push loop (ISSUE 3 satellite): retry with bounded
+exponential backoff + jitter, and a scrapeable per-outcome counter —
+against a local HTTP stub, no network deps."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from types import SimpleNamespace
+
+import pytest
+
+from lighthouse_tpu.utils import metrics
+from lighthouse_tpu.utils.monitoring import MonitoringService, collect
+
+
+def _stub_chain():
+    """The minimal chain surface collect() reads."""
+    return SimpleNamespace(
+        head_state=SimpleNamespace(slot=17),
+        fork_choice=SimpleNamespace(
+            store=SimpleNamespace(finalized_checkpoint=(2, b"\x00" * 32))
+        ),
+        network=None,
+    )
+
+
+class _Collector:
+    """HTTP stub: fails the first ``fail_first`` POSTs with 500, then
+    accepts; records every received document."""
+
+    def __init__(self, fail_first: int):
+        self.docs = []
+        self.requests = 0
+        self._fail_first = fail_first
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                outer.requests += 1
+                n = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(n)
+                if outer.requests <= outer._fail_first:
+                    self.send_response(500)
+                    self.end_headers()
+                    return
+                outer.docs.append(json.loads(body))
+                self.send_response(200)
+                self.end_headers()
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}/"
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def test_push_outcomes_counted_and_document_shape():
+    stub = _Collector(fail_first=1)
+    push_total = metrics.get("monitoring_push_total")
+    ok0 = push_total.with_labels("ok").value
+    err0 = push_total.with_labels("error").value
+    try:
+        svc = MonitoringService(_stub_chain(), stub.url, interval_s=60.0)
+        assert svc.push_once() is False      # stubbed 500
+        assert svc.push_once() is True       # accepted
+        assert svc.sent == 1 and svc.errors == 1
+        assert push_total.with_labels("ok").value == ok0 + 1
+        assert push_total.with_labels("error").value == err0 + 1
+        (doc,) = stub.docs
+        assert doc["beacon_node"]["head_slot"] == 17
+        assert doc["beacon_node"]["finalized_epoch"] == 2
+        assert doc["process"]["pid"] > 0
+    finally:
+        stub.close()
+
+
+def test_backoff_is_bounded_exponential_with_jitter():
+    svc = MonitoringService(
+        _stub_chain(), "http://127.0.0.1:9/", interval_s=60.0,
+        base_backoff_s=1.0, max_backoff_s=8.0,
+    )
+    # no failures: the regular cadence
+    assert svc.next_wait(0) == 60.0
+    # failures: ceiling doubles 1, 2, 4, 8, 8, ... with jitter in
+    # [0.5, 1.0] x ceiling — never above the cap, never near-zero
+    for fails, ceiling in ((1, 1.0), (2, 2.0), (3, 4.0), (4, 8.0), (9, 8.0)):
+        waits = [svc.next_wait(fails) for _ in range(50)]
+        assert all(0.5 * ceiling <= w <= ceiling for w in waits), (fails, waits[:5])
+    # jitter actually jitters (50 draws cannot all collide)
+    assert len({round(w, 9) for w in [svc.next_wait(3) for _ in range(50)]}) > 1
+    # the cap never exceeds the push interval itself
+    svc2 = MonitoringService(
+        _stub_chain(), "http://127.0.0.1:9/", interval_s=5.0,
+        base_backoff_s=1.0, max_backoff_s=300.0,
+    )
+    assert svc2.max_backoff_s == 5.0
+
+
+def test_push_loop_retries_through_failures():
+    """End-to-end: the loop retries with backoff past 2 stubbed failures
+    and lands a document well before the 60 s interval would allow."""
+    import time
+
+    stub = _Collector(fail_first=2)
+    try:
+        svc = MonitoringService(
+            _stub_chain(), stub.url, interval_s=0.05,
+            base_backoff_s=0.01, max_backoff_s=0.05,
+        ).start()
+        deadline = time.monotonic() + 5.0
+        while not stub.docs and time.monotonic() < deadline:
+            time.sleep(0.01)
+        svc.stop()
+        assert stub.docs, "loop never recovered past the stubbed failures"
+        assert svc.errors >= 2 and svc.sent >= 1
+    finally:
+        stub.close()

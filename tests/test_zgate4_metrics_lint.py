@@ -32,9 +32,11 @@ KNOWN_PREFIXES = (
     "beacon_processor_",
     "block_",
     "bls_device_",
+    "flight_recorder_",
     "head_",
     "http_api_",
     "log_",
+    "monitoring_",
     "network_",
     "op_pool_",
     "slasher_",
@@ -54,10 +56,13 @@ def _import_instrumented_modules():
     by test_metrics_depth instead)."""
     import lighthouse_tpu.beacon_chain.attestation_verification  # noqa: F401
     import lighthouse_tpu.beacon_chain.block_verification  # noqa: F401
+    import lighthouse_tpu.beacon_chain.validator_monitor  # noqa: F401
     import lighthouse_tpu.beacon_processor.processor  # noqa: F401
     import lighthouse_tpu.crypto.device.bls  # noqa: F401
     import lighthouse_tpu.http_api.server  # noqa: F401
+    import lighthouse_tpu.utils.flight_recorder  # noqa: F401
     import lighthouse_tpu.utils.logging  # noqa: F401
+    import lighthouse_tpu.utils.monitoring  # noqa: F401
 
 
 def test_registered_names_snake_case_with_known_prefix():
@@ -75,12 +80,16 @@ def test_registered_names_snake_case_with_known_prefix():
 
 def test_one_name_one_type_enforced():
     _import_instrumented_modules()
-    # log_lines_total is a Counter (utils/logging.py); any re-registration
-    # under another type must raise, not silently alias
+    # log_messages_total is a CounterVec (utils/logging.py, ISSUE 3
+    # replaced the unlabeled log_lines_total); any re-registration under
+    # another type must raise, not silently alias
     with pytest.raises(TypeError):
-        metrics.gauge("log_lines_total")
+        metrics.gauge("log_messages_total")
     with pytest.raises(TypeError):
-        metrics.histogram_vec("log_lines_total", labelnames=("x",))
+        metrics.histogram_vec("log_messages_total", labelnames=("x",))
+    # the replaced name must be GONE: a dashboard scraping the old
+    # unlabeled family should find nothing, not a stale twin
+    assert metrics.get("log_lines_total") is None
     # and a family is never registered under two types already
     kinds = {}
     for name, m in metrics.registry_snapshot().items():
@@ -110,6 +119,52 @@ def test_gather_parses_cleanly():
                 base = base[: -len(suffix)]
                 break
         assert base in seen_type and base in seen_help, name
+
+
+def test_new_observability_families_registered():
+    """ISSUE 3 families exist under their declared types + labels."""
+    _import_instrumented_modules()
+    reg = metrics.registry_snapshot()
+    want = {
+        "log_messages_total": ("counter", ("level",)),
+        "monitoring_push_total": ("counter", ("outcome",)),
+        "flight_recorder_events_total": ("counter", ("kind",)),
+        "flight_recorder_dumps_total": ("counter", ("trigger",)),
+        "validator_monitor_failures_total": ("counter", ("kind", "reason")),
+    }
+    for name, (kind, labels) in want.items():
+        m = reg.get(name)
+        assert m is not None, f"family {name} not registered"
+        assert m.kind == kind, (name, m.kind)
+        assert m.labelnames == labels, (name, m.labelnames)
+
+
+def test_journal_event_kinds_snake_case_and_documented():
+    """Every flight-recorder event kind is snake_case, sorted (so the
+    catalogue reads as a registry, not an accretion), and documented in
+    docs/OBSERVABILITY.md — the journal is an API surface like the
+    metric names are."""
+    import os
+
+    from lighthouse_tpu.utils import flight_recorder
+
+    kinds = flight_recorder.EVENT_KINDS
+    assert kinds, "event-kind catalogue must not be empty"
+    assert list(kinds) == sorted(kinds)
+    assert len(set(kinds)) == len(kinds)
+    docs = open(
+        os.path.join(os.path.dirname(__file__), "..", "docs", "OBSERVABILITY.md")
+    ).read()
+    for kind in kinds:
+        assert _NAME.match(kind), f"event kind not snake_case: {kind!r}"
+        assert f"`{kind}`" in docs, (
+            f"event kind {kind!r} missing from docs/OBSERVABILITY.md — the "
+            f"journal catalogue must stay documented"
+        )
+    # and the recorder refuses kinds outside the catalogue
+    if flight_recorder.enabled():
+        with pytest.raises(ValueError):
+            flight_recorder.record("zgate4_undeclared_kind")
 
 
 def test_disabled_span_costs_under_one_microsecond():

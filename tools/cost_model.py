@@ -241,6 +241,12 @@ def main() -> None:
       "as `stage_latency` in the bench JSON) gives the observed per-stage "
       "split to hold against this model — see "
       "[OBSERVABILITY.md](OBSERVABILITY.md).")
+    w("- Per-batch counterpart: every staged verify journals a "
+      "`bls_stage_verify` flight-recorder event (batch geometry, per-stage "
+      "seconds, recompile flag, verdict), so a tail-latency outlier can be "
+      "explained from its OWN stage split, not the aggregate histogram — "
+      "`tools/forensics_report.py` renders the attribution from a dump "
+      "([OBSERVABILITY.md](OBSERVABILITY.md), flight-recorder section).")
     w("")
     out = REPO / "docs" / "COST_MODEL.md"
     out.write_text("\n".join(lines) + "\n")

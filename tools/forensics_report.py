@@ -1,0 +1,153 @@
+"""Render a flight-recorder dump into a human-readable failure timeline.
+
+A dump (written by ``lighthouse_tpu.utils.flight_recorder.dump`` /
+``dump_on_failure``, schema ``lighthouse_tpu.flight_recorder/1``) holds
+the journal's last-N structured events around a failure: staged device
+BLS verifies with per-stage timings, gossip rejections with
+slot/root/reason, queue sheds, peer bans, warn+ log lines. This tool
+turns one into the narrative an operator reads:
+
+* a chronological timeline (offsets relative to the first event, thread,
+  kind, the event's key fields inline);
+* per-stage latency attribution for every ``bls_stage_verify`` event —
+  stage1/2/3 dispatch-to-sync seconds and each stage's share of the
+  batch wall time, with geometry, fp engine, recompile flag and verdict;
+* a rejection summary: counts by (kind, reason).
+
+Usage::
+
+    python tools/forensics_report.py /tmp/lighthouse_tpu_flight/<dump>.json
+    python tools/forensics_report.py --latest [--dir DIR]   # newest dump
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from collections import Counter
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# the producer owns the schema: a version bump there must fail loudly
+# here, not drift against a second literal
+from lighthouse_tpu.utils.flight_recorder import DUMP_PREFIX, SCHEMA  # noqa: E402
+
+
+def load(path: str) -> dict:
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("schema") != SCHEMA:
+        raise ValueError(
+            f"{path}: schema {doc.get('schema')!r} != expected {SCHEMA!r}"
+        )
+    return doc
+
+
+def _fields_inline(fields: dict, skip=()) -> str:
+    return " ".join(
+        f"{k}={v}" for k, v in fields.items() if k not in skip
+    )
+
+
+def render_stage_attribution(ev: dict) -> list[str]:
+    """Per-stage latency attribution lines for one bls_stage_verify event."""
+    f = ev["fields"]
+    stages = [(s, float(f.get(f"{s}_s", 0.0))) for s in ("stage1", "stage2", "stage3")]
+    total = sum(sec for _, sec in stages) or 1e-12
+    lines = [
+        "    stage latency attribution "
+        f"(B={f.get('b')} K={f.get('k')} M={f.get('m')} "
+        f"fp_impl={f.get('fp_impl')} recompiled={f.get('recompiled')} "
+        f"verdict={f.get('verdict')}):"
+    ]
+    for name, sec in stages:
+        share = 100.0 * sec / total
+        bar = "#" * int(round(share / 4))
+        lines.append(
+            f"      {name}  {sec:10.6f}s  {share:5.1f}%  {bar}"
+        )
+    lines.append(f"      total   {total:10.6f}s")
+    return lines
+
+
+def render(doc: dict) -> str:
+    evs = doc.get("events", [])
+    out = [
+        f"flight-recorder dump — trigger={doc.get('trigger')} "
+        f"captured_at={doc.get('captured_at')} pid={doc.get('pid')}",
+        f"events={len(evs)} recorded_total={doc.get('recorded_total')} "
+        f"dropped={doc.get('dropped')} capacity={doc.get('capacity')}",
+    ]
+    ctx = doc.get("context") or {}
+    if ctx:
+        out.append(f"context: {_fields_inline(ctx)}")
+    out.append("")
+    out.append("timeline:")
+    t0 = evs[0]["t"] if evs else 0.0
+    for ev in evs:
+        head = (
+            f"  +{ev['t'] - t0:9.3f}s  [{ev.get('thread', '?')}] "
+            f"{ev['kind']:<22s} {_fields_inline(ev.get('fields', {}))}"
+        )
+        out.append(head)
+        if ev["kind"] == "bls_stage_verify":
+            out.extend(render_stage_attribution(ev))
+    rejections = Counter(
+        (ev["kind"], ev["fields"].get("reason", "?"))
+        for ev in evs
+        if ev["kind"].endswith("_rejected")
+    )
+    if rejections:
+        out.append("")
+        out.append("rejections by (kind, reason):")
+        for (kind, reason), n in rejections.most_common():
+            out.append(f"  {n:6d}  {kind}  {reason}")
+    failures = [
+        ev for ev in evs
+        if ev["kind"] == "bls_stage_verify" and not ev["fields"].get("verdict", True)
+    ]
+    out.append("")
+    out.append(
+        f"staged verifies: "
+        f"{sum(1 for e in evs if e['kind'] == 'bls_stage_verify')} "
+        f"({len(failures)} failed)"
+    )
+    return "\n".join(out)
+
+
+def latest_dump(directory: str | None = None) -> str:
+    """Newest dump file in ``directory`` (default: the recorder's
+    configured dump dir). Names embed a ms timestamp, so lexicographic
+    max is the newest."""
+    from lighthouse_tpu.utils import flight_recorder
+
+    directory = directory or flight_recorder.status()["dump_dir"]
+    names = sorted(
+        n for n in os.listdir(directory) if n.startswith(DUMP_PREFIX)
+    )
+    if not names:
+        raise FileNotFoundError(f"no flight-recorder dumps in {directory}")
+    return os.path.join(directory, names[-1])
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("dump", nargs="?", help="dump JSON path")
+    ap.add_argument("--latest", action="store_true",
+                    help="render the newest dump in --dir")
+    ap.add_argument("--dir", default=None,
+                    help="dump directory for --latest")
+    args = ap.parse_args(argv)
+    if args.latest:
+        path = latest_dump(args.dir)
+    elif args.dump:
+        path = args.dump
+    else:
+        ap.error("give a dump path or --latest")
+    print(render(load(path)))
+
+
+if __name__ == "__main__":
+    main()
