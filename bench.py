@@ -193,6 +193,84 @@ def _median_spread(samples: list[float]) -> tuple[float, float]:
     return med, spread
 
 
+def measure_scheduler_leg(sets, B, K, M, n_callers: int = 4, reps: int = 3):
+    """Fused-scheduler vs direct per-caller throughput at the headline
+    geometry (ISSUE 4). Both legs run the SAME compiled staged program at
+    the SAME padded shape — no new XLA compiles: the `direct` leg pays
+    ``n_callers`` dispatches of a 1/n-occupied bucket (the fragmented
+    traffic shape the scheduler exists to fix), the `fused` leg pays one
+    full-occupancy dispatch assembled by concurrent ``submit()`` calls
+    from ``n_callers`` feeder threads."""
+    import threading
+
+    import jax
+
+    from lighthouse_tpu.crypto.device.bls import (
+        pack_signature_sets_raw,
+        verify_batch_raw_staged,
+    )
+    from lighthouse_tpu.verification_service import VerificationScheduler
+
+    chunk = (len(sets) + n_callers - 1) // n_callers
+    chunks = [sets[i: i + chunk] for i in range(0, len(sets), chunk)]
+
+    def device_verify(s):
+        args = pack_signature_sets_raw(s, pad_b=B, pad_k=K, pad_m=M)
+        return bool(jax.block_until_ready(verify_batch_raw_staged(*args)))
+
+    assert device_verify(sets) is True  # warm (shape compiled by headline)
+
+    direct = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for c in chunks:
+            assert device_verify(c)
+        direct.append(time.perf_counter() - t0)
+
+    kinds = ("unaggregated", "aggregate", "sync_message", "sync_contribution")
+    sched = VerificationScheduler(
+        verify_fn=device_verify,
+        deadline_ms=2000.0,
+        max_batch_sets=len(sets),  # bucket-full fires on the last feeder
+        max_queue_sets=4 * len(sets),
+    ).start()
+    fused = []
+    try:
+        for _ in range(reps):
+            futs = [None] * len(chunks)
+
+            def feed(i):
+                futs[i] = sched.submit(chunks[i], kinds[i % len(kinds)])
+
+            threads = [
+                threading.Thread(target=feed, args=(i,))
+                for i in range(len(chunks))
+            ]
+            t0 = time.perf_counter()
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            assert all(f.result(timeout=600) for f in futs)
+            fused.append(time.perf_counter() - t0)
+    finally:
+        sched.stop()
+
+    d_med, d_spread = _median_spread(direct)
+    f_med, f_spread = _median_spread(fused)
+    n = len(sets)
+    return {
+        "n_callers": len(chunks),
+        "sets_per_caller": chunk,
+        "B": B, "K": K, "M": M, "reps": reps,
+        "direct_sets_per_sec": round(n / d_med, 2),
+        "direct_rep_spread": round(d_spread, 3),
+        "fused_sets_per_sec": round(n / f_med, 2),
+        "fused_rep_spread": round(f_spread, 3),
+        "fused_vs_direct": round(d_med / f_med, 4),
+    }
+
+
 def measure_native_baseline(sets, reps: int = REPS):
     """Median-of-reps sets/s of the native C backend on the same workload
     (the reference seam, blst.rs:36-119, measured as BASELINE.md
@@ -295,6 +373,16 @@ def main() -> None:
         except Exception as e:  # a failed bucket must not kill the line
             buckets.append({"K": spec["K"], "error": str(e)[:200]})
 
+    # Fused-scheduler vs fragmented per-caller dispatch at the headline
+    # shape (same compiled program both legs, see measure_scheduler_leg).
+    if _budget_left() < 300:
+        scheduler_leg = {"skipped": "budget"}
+    else:
+        try:
+            scheduler_leg = measure_scheduler_leg(sets, B_PAD, K_PAD, M_PAD)
+        except Exception as e:  # the leg must not kill the line
+            scheduler_leg = {"error": str(e)[:200]}
+
     baseline, base_spread = measure_native_baseline(sets)
     sets_per_sec = headline["sets_per_sec"]
     agg_per_sec = sets_per_sec / 3.0
@@ -364,6 +452,7 @@ def main() -> None:
                 "fp_impl": headline_impl,
                 "fp_impl_legs": impl_legs,
                 "stage_latency": headline.get("stage_latency", {}),
+                "scheduler_leg": scheduler_leg,
                 "buckets": buckets,
             }
         )

@@ -14,7 +14,6 @@ from __future__ import annotations
 import hashlib
 from dataclasses import dataclass
 
-from ..crypto import bls
 from ..crypto.bls import BlsError
 from ..ssz import hash_tree_root
 from ..state_transition.helpers import compute_epoch_at_slot
@@ -23,6 +22,7 @@ from ..state_transition.signature_sets import (
     indexed_attestation_set,
 )
 from ..utils import flight_recorder, metrics, tracing
+from ..verification_service import backend_verify, backend_verify_each
 
 ATTESTATION_PROPAGATION_SLOT_RANGE = 32
 TARGET_AGGREGATORS_PER_COMMITTEE = 16
@@ -196,7 +196,7 @@ def verify_unaggregated_attestation(chain, att, current_slot: int):
         except BlsError:
             raise AttestationError("InvalidSignature")
     try:
-        ok = bls.verify_signature_sets([s])
+        ok = backend_verify(chain, [s], "unaggregated")
     except BlsError:  # malformed signature bytes = invalid, never a crash
         ok = False
     if not ok:
@@ -238,14 +238,22 @@ def batch_verify_unaggregated_attestations(chain, attestations, current_slot: in
                     results[pos] = AttestationError("InvalidSignature")
         with tracing.span("attestation.signature", n_sets=len(pending)), \
                 _BATCH_SIG.with_labels("unaggregated").time():
-            batch_ok = bool(pending) and bls.verify_signature_sets(
-                [p[4] for p in pending]
+            # backend_verify routes through the chain's verification
+            # scheduler when one is attached (cross-caller fused device
+            # batches, verification_service/batcher.py); verdicts are
+            # identical to the direct call either way.
+            batch_ok = bool(pending) and backend_verify(
+                chain, [p[4] for p in pending], "unaggregated"
             )
-            # per-item fallback (reference batch.rs:115-119) — still unlocked
-            item_ok = {
-                p[0]: batch_ok or bls.verify_signature_sets([p[4]])
-                for p in pending
-            }
+            # per-item fallback (reference batch.rs:115-119) — still
+            # unlocked; submitted together so the retries fuse too
+            if batch_ok:
+                item_ok = {p[0]: True for p in pending}
+            else:
+                each = backend_verify_each(
+                    chain, [[p[4]] for p in pending], "unaggregated"
+                )
+                item_ok = {p[0]: ok for p, ok in zip(pending, each)}
         with chain._chain_lock:
             for pos, att, indexed, vindex, s in pending:
                 if item_ok[pos]:
@@ -330,7 +338,7 @@ def verify_aggregated_attestation(chain, signed_agg, current_slot: int):
         except BlsError:
             raise AttestationError("InvalidSignature")
     try:
-        ok = bls.verify_signature_sets(sets)
+        ok = backend_verify(chain, sets, "aggregate")
     except BlsError:
         ok = False
     if not ok:
@@ -385,11 +393,16 @@ def _batch_verify_aggregated_inner(
     with tracing.span("attestation.signature", n_sets=3 * len(pending)), \
             _BATCH_SIG.with_labels("aggregate").time():
         all_sets = [s for p in pending for s in p[4]]
-        batch_ok = bool(pending) and bls.verify_signature_sets(all_sets)
-        item_ok = {
-            p[0]: batch_ok or bls.verify_signature_sets(p[4])
-            for p in pending
-        }
+        batch_ok = bool(pending) and backend_verify(
+            chain, all_sets, "aggregate"
+        )
+        if batch_ok:
+            item_ok = {p[0]: True for p in pending}
+        else:
+            each = backend_verify_each(
+                chain, [p[4] for p in pending], "aggregate"
+            )
+            item_ok = {p[0]: ok for p, ok in zip(pending, each)}
     with chain._chain_lock:
         for pos, sa, indexed, att_root, sets in pending:
             if item_ok[pos]:

@@ -15,7 +15,6 @@ from __future__ import annotations
 import copy
 from dataclasses import dataclass, field as dc_field
 
-from ..crypto import bls
 from ..ssz import hash_tree_root
 from ..state_transition import (
     BlockSignatureAccumulator,
@@ -25,6 +24,7 @@ from ..state_transition import (
 from ..state_transition.epoch import fork_of
 from ..state_transition.signature_sets import block_proposal_set
 from ..utils import flight_recorder, metrics, tracing
+from ..verification_service import backend_verify_now
 
 _STAGE_SECONDS = metrics.histogram_vec(
     "beacon_block_verification_seconds",
@@ -133,7 +133,9 @@ class GossipVerifiedBlock:
             chain.preset, chain.spec, state, signed_block,
             chain.pubkey_cache.resolver(), block_root=block_root,
         )
-        if not bls.verify_signature_sets([s]):
+        # block verification is latency-critical (a late block loses the
+        # slot): the scheduler's SYNCHRONOUS bypass, never the fusing queue
+        if not backend_verify_now(chain, [s], kind="block"):
             raise BlockError("ProposalSignatureInvalid")
         chain.observed_block_producers.observe(block.proposer_index, block.slot)
         return cls(signed_block, block_root, state)
@@ -192,7 +194,9 @@ class SignatureVerifiedBlock:
                     acc.include_operations(signed_block)
                 else:
                     acc.include_all(signed_block, block_root=block_root)
-                ok = acc.verify()
+                # same bypass as the proposal check: the full-block batch
+                # must not wait on the gossip fusing deadline
+                ok = backend_verify_now(chain, acc.sets, kind="block")
             except BlsError:  # malformed signature bytes in the block body
                 ok = False
         _OUTCOMES.with_labels(

@@ -187,6 +187,9 @@ class BeaconChain:
         self.op_pool = None  # attached by the client builder when present
         self.slasher = None  # attached by the client builder when enabled
         self.validator_monitor = None  # attached when monitoring is on
+        # cross-caller batching scheduler (verification_service/batcher.py),
+        # attached by the client builder; None = direct backend calls
+        self.verification_scheduler = None
 
         # (root, state) swapped as ONE tuple so unlocked readers (HTTP
         # routes, duty production) always see a consistent pair; exposed
@@ -419,7 +422,11 @@ class BeaconChain:
                 self.preset, self.spec, state, sb, fork_of(state),
                 signature_strategy="none",
             )
-        if not bls.verify_signature_sets(all_sets):
+        # segment import is sync-critical (the caller is blocked on the
+        # whole range): the scheduler bypass, never the fusing queue
+        from ..verification_service import backend_verify_now
+
+        if not backend_verify_now(self, all_sets, kind="chain_segment"):
             raise BlockError("InvalidSignature", "chain segment batch")
         return out
 

@@ -24,6 +24,7 @@ from ..types.chain_spec import (
 )
 from ..types.domains import compute_signing_root, get_domain
 from ..utils import flight_recorder
+from ..verification_service import backend_verify, backend_verify_each
 
 TARGET_AGGREGATORS_PER_SYNC_SUBCOMMITTEE = 16
 
@@ -177,20 +178,26 @@ def batch_verify_sync_committee_messages(chain, messages):
             except SyncCommitteeError as e:
                 results[pos] = e
     try:
-        batch_ok = bool(pending) and bls.verify_signature_sets(
-            [p[3] for p in pending]
+        # scheduler-aware (verification_service/batcher.py): sync batches
+        # fuse with concurrent attestation traffic into shared device
+        # batches when the chain carries a scheduler
+        batch_ok = bool(pending) and backend_verify(
+            chain, [p[3] for p in pending], "sync_message"
         )
     except bls.BlsError:
         batch_ok = False
-    item_ok = {}
-    for p in pending:
-        if batch_ok:
-            item_ok[p[0]] = True
-        else:
-            try:
-                item_ok[p[0]] = bls.verify_signature_sets([p[3]])
-            except bls.BlsError:
-                item_ok[p[0]] = False
+    if batch_ok:
+        item_ok = {p[0]: True for p in pending}
+    else:
+        item_ok = {}
+        try:
+            each = backend_verify_each(
+                chain, [[p[3]] for p in pending], "sync_message"
+            )
+        except bls.BlsError:
+            each = [False] * len(pending)
+        for p, ok in zip(pending, each):
+            item_ok[p[0]] = ok
     with chain._chain_lock:
         for pos, m, positions, _s in pending:
             if not item_ok[pos]:
@@ -327,7 +334,7 @@ def _verify_sync_contribution_inner(chain, signed) -> VerifiedSyncContribution:
             ),
         ]
     try:
-        ok = bls.verify_signature_sets(sets)
+        ok = backend_verify(chain, sets, "sync_contribution")
     except bls.BlsError:
         ok = False
     if not ok:

@@ -42,6 +42,12 @@ class ClientConfig:
     listen_host: str = "127.0.0.1"
     boot_nodes: tuple = ()  # "host:port" strings dialed at startup
     monitoring_endpoint: Optional[str] = None  # remote metrics push URL
+    # cross-caller continuous batching for BLS verification
+    # (verification_service/batcher.py); False = direct backend calls
+    verification_scheduler: bool = True
+    scheduler_deadline_ms: float = 25.0
+    scheduler_max_batch_sets: int = 256
+    scheduler_max_queue_sets: int = 2048
 
 
 class Client:
@@ -71,6 +77,12 @@ class Client:
             monitor = getattr(self.chain, "validator_monitor", None)
             if monitor is not None:
                 monitor.detach()  # stop feeding a dead client's monitor
+            sched = getattr(self.chain, "verification_scheduler", None)
+            if sched is not None:
+                # drain BEFORE the processor joins its workers: stop()
+                # resolves every queued future, and post-stop submissions
+                # degrade to synchronous direct calls
+                sched.stop()
             self.processor.shutdown()
             self.persist()
             if self.monitoring is not None:
@@ -303,6 +315,18 @@ class ClientBuilder:
             from .ssz import hash_tree_root as _htr
 
             store.put_block(_htr(cp_block.message), cp_block)
+
+        if cfg.verification_scheduler:
+            # the continuous-batching layer: gossip verifiers submit
+            # through chain.verification_scheduler and their signature
+            # sets fuse into shared device batches across callers
+            from .verification_service import VerificationScheduler
+
+            chain.verification_scheduler = VerificationScheduler(
+                deadline_ms=cfg.scheduler_deadline_ms,
+                max_batch_sets=cfg.scheduler_max_batch_sets,
+                max_queue_sets=cfg.scheduler_max_queue_sets,
+            ).start()
 
         processor = _build_processor(chain, cfg.n_workers)
 

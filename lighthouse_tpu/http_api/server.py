@@ -410,6 +410,12 @@ class BeaconApiServer:
                 None if bn is None else {"peer_count": bn.get("peers", 0)}
             )
             doc["flight_recorder"] = flight_recorder.status()
+            # continuous-batching scheduler: queue depth + batch occupancy
+            # (null when the chain runs without one)
+            sched = getattr(chain, "verification_scheduler", None)
+            doc["verification_scheduler"] = (
+                None if sched is None else sched.status()
+            )
             return {"data": doc}
         if path == "/lighthouse/flight_recorder":
             # live journal tail: ?kind=a,b filters, ?limit=N bounds the

@@ -62,6 +62,9 @@ EVENT_KINDS = (
     "peer_ban",               # network/peer_manager.py
     "peer_penalty",           # network/peer_manager.py
     "queue_shed",             # beacon_processor/processor.py
+    "scheduler_bisection",    # verification_service/batcher.py, per split
+    "scheduler_flush",        # verification_service/batcher.py, per batch
+    "scheduler_shed",         # verification_service/batcher.py, backpressure
     "sync_rejected",          # beacon_chain/sync_committee_verification.py
 )
 _KINDS = frozenset(EVENT_KINDS)

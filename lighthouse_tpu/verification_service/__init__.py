@@ -1,0 +1,26 @@
+"""Verification service: the continuous-batching layer between the
+gossip verifiers and the BLS backend (see ``batcher.py``). Callers
+submit signature sets; the scheduler fuses submissions from many
+producers into shared fixed-geometry device batches under a latency
+deadline, with split-and-retry isolation so per-submission verdicts
+stay identical to direct per-caller calls."""
+
+from .batcher import (
+    BUCKET_LADDER,
+    VerificationScheduler,
+    backend_verify,
+    backend_verify_each,
+    backend_verify_now,
+    round_up_bucket,
+    scheduler_of,
+)
+
+__all__ = [
+    "BUCKET_LADDER",
+    "VerificationScheduler",
+    "backend_verify",
+    "backend_verify_each",
+    "backend_verify_now",
+    "round_up_bucket",
+    "scheduler_of",
+]

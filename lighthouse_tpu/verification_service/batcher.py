@@ -1,0 +1,506 @@
+"""Cross-caller continuous batching for BLS signature-set verification.
+
+The cost model (docs/COST_MODEL.md) and the padding-waste gauge from the
+device telemetry say the same thing: per-batch fixed overhead (host pack,
+dispatch, padded lanes) amortizes only at large B, yet every gossip
+caller — attestation batches, sync-committee batches, single-item API
+paths — issues its own synchronous ``bls.verify_signature_sets`` call,
+so device batches are capped at ONE caller's burst. This module is the
+continuous-batching layer between the verifiers and the backend
+("Performance of EdDSA and BLS Signatures in Committee-Based Consensus",
+PAPERS.md: batch-aggregated BLS verification is the throughput lever):
+concurrent producers ``submit(sets, kind)`` and a flush thread fuses
+their submissions into shared batches whose padded size lands on the
+same ``_round_up`` bucket ladder the device packers use, so the XLA
+recompile count stays bounded across traffic shapes.
+
+Semantics contract (the part that makes fusing safe): per-submission
+verdicts are IDENTICAL to a direct per-caller ``verify_signature_sets``
+call.
+
+* A fused batch that verifies True proves every member submission would
+  verify True on its own (the standard 2^-64 random-linear-combination
+  soundness — the same argument the existing batch-then-fallback caller
+  paths already rely on).
+* A fused batch that verifies False is split-and-retried (bisection):
+  halves re-verify until the poisoned submission(s) are isolated, and a
+  LEAF verdict is literally the direct call ``verify(sets_of_that_
+  submission)`` — byte-identical by construction. One bad attestation
+  can therefore never reject another caller's block.
+* An empty submission resolves False immediately (``verify_signature_
+  sets([])`` is False) and never joins a fused batch, where its absence
+  of sets would otherwise let a neighbour's verdict stand in for it.
+
+Flush triggers: geometry-bucket-full (pending sets reached
+``max_batch_sets``), deadline (oldest submission waited ``deadline_ms``),
+explicit ``flush()``, and shutdown drain.
+
+Backpressure: the pending queue is bounded by ``max_queue_sets``. A
+submission that would overflow it is SHED to caller fallback — verified
+synchronously in the caller's thread (identical verdict, no fusing) —
+and journaled as a ``scheduler_shed`` flight-recorder event, so overload
+degrades to exactly the pre-scheduler behavior instead of queueing
+without bound.
+
+Latency-critical callers (block verification) use :meth:`verify_now`,
+a counted synchronous bypass that never waits on a deadline.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Callable, List, Optional, Sequence
+
+from ..crypto import bls
+from ..utils import flight_recorder, metrics, tracing
+
+# Mirrors crypto/device/bls._round_up's choices without importing the
+# device stack (jax) here; tests/test_verification_scheduler.py pins the
+# two ladders equal so they cannot drift apart.
+BUCKET_LADDER = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
+
+
+def round_up_bucket(n: int, ladder: Sequence[int] = BUCKET_LADDER) -> int:
+    """Padded batch size for ``n`` fused sets — same ladder the device
+    packers pad to, so a flush of any size maps onto a bounded set of
+    compiled shapes."""
+    for c in ladder:
+        if n <= c:
+            return c
+    top = ladder[-1]
+    return ((n + top - 1) // top) * top
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, ""))
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, ""))
+    except ValueError:
+        return default
+
+
+_FUSED_BATCHES = metrics.counter_vec(
+    "verification_scheduler_fused_batches_total",
+    "fused device batches dispatched, labeled by the sorted caller-kind "
+    "mix (e.g. aggregate+sync_message+unaggregated)",
+    ("kinds",),
+)
+_SUBMISSIONS = metrics.counter_vec(
+    "verification_scheduler_submissions_total",
+    "submissions resolved, by caller kind and verdict outcome",
+    ("kind", "outcome"),
+)
+_SETS_TOTAL = metrics.counter_vec(
+    "verification_scheduler_sets_total",
+    "signature sets fused into shared batches, per caller kind",
+    ("kind",),
+)
+_FLUSHES = metrics.counter_vec(
+    "verification_scheduler_flushes_total",
+    "batch flushes by trigger (full = bucket ceiling reached, deadline = "
+    "oldest submission hit the latency budget, explicit, shutdown)",
+    ("trigger",),
+)
+_OCCUPANCY = metrics.gauge(
+    "verification_scheduler_batch_occupancy_ratio",
+    "real sets / padded ladder bucket of the most recent fused batch",
+)
+_PAD_WASTE = metrics.gauge(
+    "verification_scheduler_padding_waste_ratio",
+    "1 - occupancy of the most recent fused batch (the lanes the device "
+    "pays for that no caller asked for)",
+)
+_QUEUE_DEPTH = metrics.gauge(
+    "verification_scheduler_queue_depth",
+    "signature sets currently queued awaiting a flush",
+)
+_QUEUE_WAIT = metrics.histogram(
+    "verification_scheduler_queue_wait_seconds",
+    "submit-to-dispatch wait per submission (bounded by the deadline)",
+)
+_BISECTIONS = metrics.counter(
+    "verification_scheduler_bisections_total",
+    "split-and-retry group verifications run to isolate poisoned "
+    "submissions after a fused batch failed",
+)
+_SHED = metrics.counter_vec(
+    "verification_scheduler_shed_total",
+    "submissions shed to synchronous caller fallback on a full queue",
+    ("kind",),
+)
+_BYPASS = metrics.counter_vec(
+    "verification_scheduler_bypass_total",
+    "synchronous verify_now calls (latency-critical callers, e.g. block "
+    "verification) that skip the fusing queue",
+    ("kind",),
+)
+
+
+class _Submission:
+    __slots__ = ("kind", "sets", "future", "submitted_at")
+
+    def __init__(self, kind: str, sets: List):
+        self.kind = kind
+        self.sets = sets
+        self.future: Future = Future()
+        self.submitted_at = time.monotonic()
+
+
+class VerificationScheduler:
+    """Thread-safe cross-caller batcher: ``submit(sets, kind) -> Future``
+    fuses submissions from concurrent producers into shared
+    ``verify_signature_sets`` batches (see module docstring for the
+    verdict-identity contract)."""
+
+    def __init__(
+        self,
+        verify_fn: Optional[Callable[[list], bool]] = None,
+        deadline_ms: float | None = None,
+        max_batch_sets: int | None = None,
+        max_queue_sets: int | None = None,
+    ):
+        self._verify = verify_fn or bls.verify_signature_sets
+        self.deadline_s = (
+            deadline_ms
+            if deadline_ms is not None
+            else _env_float("LIGHTHOUSE_TPU_SCHED_DEADLINE_MS", 25.0)
+        ) / 1000.0
+        self.max_batch_sets = int(
+            max_batch_sets
+            if max_batch_sets is not None
+            else _env_int("LIGHTHOUSE_TPU_SCHED_MAX_BATCH", 256)
+        )
+        self.max_queue_sets = int(
+            max_queue_sets
+            if max_queue_sets is not None
+            else _env_int("LIGHTHOUSE_TPU_SCHED_MAX_QUEUE", 2048)
+        )
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._pending: deque[_Submission] = deque()
+        self._pending_sets = 0
+        self._flush_requested = False
+        self._stopped = True  # not accepting until start()
+        self._thread: Optional[threading.Thread] = None
+        # own counters for status(): the health endpoint should not have
+        # to parse the exposition to describe the scheduler
+        self._fused_batches = 0
+        self._bisections = 0
+        self._shed = 0
+        self._buckets_seen: set[int] = set()
+        self._last_occupancy = 0.0
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> "VerificationScheduler":
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return self
+            self._stopped = False
+            self._thread = threading.Thread(
+                target=self._loop, name="verification-scheduler", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Stop accepting queued work and drain: everything already
+        submitted resolves (final flush, trigger=shutdown); later
+        ``submit`` calls fall back to a synchronous direct call."""
+        with self._cv:
+            if self._stopped and self._thread is None:
+                return
+            self._stopped = True
+            self._cv.notify_all()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=10)
+        self._thread = None
+
+    def running(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive() and not self._stopped
+
+    # -- submission -------------------------------------------------------
+
+    def submit(self, sets, kind: str) -> Future:
+        """Queue one caller's signature sets for fused verification.
+        Returns a Future resolving to the same bool a direct
+        ``bls.verify_signature_sets(sets)`` call would return."""
+        sub = _Submission(kind, list(sets))
+        if not sub.sets:
+            # matches verify_signature_sets([]) == False; must not join a
+            # fused batch where it would have no sets to vote with
+            self._finish(sub, False)
+            return sub.future
+        shed = False
+        with self._cv:
+            if self._stopped:
+                shed = True  # not running: degrade to the direct call
+            elif (
+                self._pending
+                and self._pending_sets + len(sub.sets) > self.max_queue_sets
+            ):
+                # backpressure: full queue sheds to caller fallback. An
+                # oversized submission on an EMPTY queue is accepted — it
+                # flushes as its own batch and could never fit otherwise.
+                shed = True
+            if shed:
+                self._shed += 1
+            else:
+                was_empty = not self._pending
+                self._pending.append(sub)
+                self._pending_sets += len(sub.sets)
+                _QUEUE_DEPTH.set(self._pending_sets)
+                if was_empty or self._pending_sets >= self.max_batch_sets:
+                    # wake the flush thread: it must (re)arm the deadline
+                    # timer for a fresh queue, or fire the bucket-full flush
+                    self._cv.notify()
+        if shed:
+            _SHED.with_labels(kind).inc()
+            flight_recorder.record(
+                "scheduler_shed",
+                kind=kind,
+                n_sets=len(sub.sets),
+                queue_sets=self._pending_sets,
+                bound=self.max_queue_sets,
+                running=self.running(),
+            )
+            with tracing.span(
+                "scheduler.shed_fallback", kind=kind, n_sets=len(sub.sets)
+            ):
+                # leaf resolution in the caller's thread: verdict, outcome
+                # accounting and exception delivery all match the direct
+                # call this submission degraded to
+                self._resolve_group([sub])
+        return sub.future
+
+    def verify_now(self, sets, kind: str = "block") -> bool:
+        """Synchronous bypass for latency-critical callers: identical to
+        a direct backend call, counted so dashboards can see how much
+        traffic skips the fusing queue."""
+        sets = list(sets)
+        _BYPASS.with_labels(kind).inc()
+        with tracing.span("scheduler.bypass", kind=kind, n_sets=len(sets)):
+            return self._verify(sets)
+
+    def flush(self) -> None:
+        """Ask the flush thread to dispatch whatever is pending now."""
+        with self._cv:
+            self._flush_requested = True
+            self._cv.notify()
+
+    # -- flush loop -------------------------------------------------------
+
+    def _oldest_deadline(self) -> Optional[float]:
+        if not self._pending:
+            return None
+        return self._pending[0].submitted_at + self.deadline_s
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while True:
+                    if self._stopped:
+                        trigger = "shutdown"
+                        break
+                    if self._flush_requested:
+                        trigger = "explicit"
+                        break
+                    if self._pending_sets >= self.max_batch_sets:
+                        trigger = "full"
+                        break
+                    deadline = self._oldest_deadline()
+                    now = time.monotonic()
+                    if deadline is not None and now >= deadline:
+                        trigger = "deadline"
+                        break
+                    self._cv.wait(
+                        None if deadline is None else deadline - now
+                    )
+                subs = self._drain_locked()
+                self._flush_requested = False
+                stopped = self._stopped
+            if subs:
+                self._flush_batch(subs, trigger)
+            elif stopped:
+                return
+
+    def _drain_locked(self) -> List[_Submission]:
+        """Take at most one bucket's worth of submissions (whole
+        submissions only — a submission is the isolation unit and never
+        splits across fused batches). Called under the lock."""
+        subs: List[_Submission] = []
+        n = 0
+        while self._pending:
+            nxt = self._pending[0]
+            if subs and n + len(nxt.sets) > self.max_batch_sets:
+                break
+            subs.append(self._pending.popleft())
+            n += len(nxt.sets)
+        self._pending_sets -= n
+        _QUEUE_DEPTH.set(self._pending_sets)
+        return subs
+
+    def _flush_batch(self, subs: List[_Submission], trigger: str) -> None:
+        n_sets = sum(len(s.sets) for s in subs)
+        bucket = round_up_bucket(n_sets)
+        kinds_mix = "+".join(sorted({s.kind for s in subs}))
+        now = time.monotonic()
+        for s in subs:
+            _QUEUE_WAIT.observe(now - s.submitted_at)
+            _SETS_TOTAL.with_labels(s.kind).inc(len(s.sets))
+        occupancy = n_sets / float(bucket)
+        _FUSED_BATCHES.with_labels(kinds_mix).inc()
+        _FLUSHES.with_labels(trigger).inc()
+        _OCCUPANCY.set(occupancy)
+        _PAD_WASTE.set(1.0 - occupancy)
+        self._fused_batches += 1
+        self._buckets_seen.add(bucket)
+        self._last_occupancy = occupancy
+        bisections_before = self._bisections
+        with tracing.span(
+            "scheduler.flush",
+            trigger=trigger,
+            kinds=kinds_mix,
+            n_submissions=len(subs),
+            n_sets=n_sets,
+        ) as sp:
+            all_ok = self._resolve_group(subs)
+            sp.set(verdict=all_ok)
+        flight_recorder.record(
+            "scheduler_flush",
+            trigger=trigger,
+            kinds=kinds_mix,
+            n_submissions=len(subs),
+            n_sets=n_sets,
+            bucket=bucket,
+            occupancy=round(occupancy, 4),
+            verdict=all_ok,
+            bisections=self._bisections - bisections_before,
+        )
+
+    # -- verdict resolution (split-and-retry isolation) -------------------
+
+    def _resolve_group(self, subs: List[_Submission]) -> bool:
+        """Verify ``subs`` as one fused call; on False — or on a raised
+        backend exception, which a larger fused shape can hit even when
+        each member's own call would not — bisect so every submission
+        ends at exactly the verdict (or exception) its own direct call
+        produces. Only a LEAF failure is delivered to a future."""
+        try:
+            ok = bool(self._verify([st for s in subs for st in s.sets]))
+        except BaseException as e:  # noqa: BLE001 — flush thread survives
+            if len(subs) == 1:
+                sub = subs[0]
+                # this fused call WAS the direct call: the caller would
+                # have seen the raise, so the future carries it
+                _SUBMISSIONS.with_labels(sub.kind, "error").inc()
+                if not sub.future.done():
+                    sub.future.set_exception(e)
+                return False
+            return self._bisect(subs)
+        if ok:
+            for s in subs:
+                self._finish(s, True)
+            return True
+        if len(subs) == 1:
+            # leaf: this fused call WAS the direct per-caller call
+            self._finish(subs[0], False)
+            return False
+        return self._bisect(subs)
+
+    def _bisect(self, subs: List[_Submission]) -> bool:
+        self._bisections += 1
+        _BISECTIONS.inc()
+        flight_recorder.record(
+            "scheduler_bisection",
+            n_submissions=len(subs),
+            n_sets=sum(len(s.sets) for s in subs),
+            kinds="+".join(sorted({s.kind for s in subs})),
+        )
+        mid = len(subs) // 2
+        left = self._resolve_group(subs[:mid])
+        right = self._resolve_group(subs[mid:])
+        return left and right
+
+    def _finish(self, sub: _Submission, ok: bool) -> None:
+        _SUBMISSIONS.with_labels(sub.kind, "ok" if ok else "invalid").inc()
+        if not sub.future.done():
+            sub.future.set_result(ok)
+
+    # -- introspection ----------------------------------------------------
+
+    def status(self) -> dict:
+        """One document for /lighthouse/health: queue depth, occupancy,
+        config, and the padded buckets this process has dispatched (the
+        recompile-bound surface)."""
+        with self._lock:
+            pending_subs = len(self._pending)
+            pending_sets = self._pending_sets
+        return {
+            "running": self.running(),
+            "queue_submissions": pending_subs,
+            "queue_sets": pending_sets,
+            "max_batch_sets": self.max_batch_sets,
+            "max_queue_sets": self.max_queue_sets,
+            "deadline_ms": round(self.deadline_s * 1000.0, 3),
+            "fused_batches_total": self._fused_batches,
+            "bisections_total": self._bisections,
+            "shed_total": self._shed,
+            "last_batch_occupancy": round(self._last_occupancy, 4),
+            "buckets_seen": sorted(self._buckets_seen),
+        }
+
+
+# ---------------------------------------------------------------------------
+# Caller-side helpers: one spelling for "verify these sets, fused when a
+# scheduler is attached to the chain, direct otherwise".
+# ---------------------------------------------------------------------------
+
+
+def scheduler_of(chain) -> Optional[VerificationScheduler]:
+    sched = getattr(chain, "verification_scheduler", None)
+    if sched is not None and sched.running():
+        return sched
+    return None
+
+
+def backend_verify(chain, sets, kind: str) -> bool:
+    """One batch verification for ``chain``: submitted to the attached
+    scheduler (cross-caller fusing) when present, else the direct
+    backend call. Verdict identical either way."""
+    sched = scheduler_of(chain)
+    if sched is None:
+        return bls.verify_signature_sets(sets)
+    return sched.submit(sets, kind).result()
+
+
+def backend_verify_each(chain, list_of_sets, kind: str) -> List[bool]:
+    """Per-item fallback helper: verify each element of ``list_of_sets``
+    independently. With a scheduler the items are submitted together
+    first so they fuse into one retry batch instead of N serial calls."""
+    sched = scheduler_of(chain)
+    if sched is None:
+        return [bls.verify_signature_sets(s) for s in list_of_sets]
+    futures = [sched.submit(s, kind) for s in list_of_sets]
+    return [f.result() for f in futures]
+
+
+def backend_verify_now(chain, sets, kind: str = "block") -> bool:
+    """Latency-critical callers (block verification): the scheduler's
+    counted synchronous bypass when attached, else the direct call."""
+    sched = scheduler_of(chain)
+    if sched is None:
+        return bls.verify_signature_sets(sets)
+    return sched.verify_now(sets, kind)

@@ -209,6 +209,10 @@ def test_blinded_block_roundtrip_bellatrix():
 
 
 def test_am_deposits_and_exit(tmp_path):
+    # the account-manager keystore paths (scrypt/AES) need the optional
+    # cryptography dependency — skip cleanly where the box lacks it, like
+    # the network/keys test modules already do at collection
+    pytest.importorskip("cryptography")
     from lighthouse_tpu.cli import main
 
     wallet = tmp_path / "wallet.json"

@@ -1,0 +1,427 @@
+"""Verification scheduler (ISSUE 4): cross-caller continuous batching.
+
+Functional coverage of ``verification_service/batcher.py`` on fast
+backends (fake / cpu-native): multithreaded feeders across >=3 caller
+kinds fuse into shared batches, per-submission verdicts are identical
+to direct per-caller calls (including the poisoned-set bisection case),
+the deadline flush fires on a lone submission, backpressure sheds to
+caller fallback, and the flush buckets stay on the device packer's
+``_round_up`` ladder. Heavy staged-device variants live in
+``tests/test_zgate5_scheduler_pipeline.py`` (tail-sorted)."""
+
+import threading
+import time
+
+import pytest
+
+from lighthouse_tpu.crypto import backend, bls
+from lighthouse_tpu.utils import flight_recorder, metrics
+from lighthouse_tpu.verification_service import (
+    BUCKET_LADDER,
+    VerificationScheduler,
+    backend_verify,
+    round_up_bucket,
+)
+
+KINDS = ("unaggregated", "aggregate", "sync_message")
+
+
+@pytest.fixture
+def fake_backend():
+    backend.set_backend("fake")
+    yield
+    backend.set_backend("cpu")
+
+
+# one real (sk, pk, sig) triple shared by every fake-backend test: the
+# fake backend never inspects the crypto, but the SignatureSet wrappers
+# and the infinity pre-screen in bls.verify_signature_sets are real
+_SK = bls.SecretKey(7)
+_PK = bls.PublicKey.deserialize(_SK.public_key().serialize())
+_MSG = b"\x11" * 32
+_SIG = bls.Signature.deserialize(_SK.sign(_MSG).serialize())
+
+
+def _set(n_pks: int = 1) -> bls.SignatureSet:
+    return bls.SignatureSet.multiple_pubkeys(_SIG, [_PK] * n_pks, _MSG)
+
+
+def _poisoned() -> bls.SignatureSet:
+    # empty signing-keys: False on EVERY backend (the reference's empty-
+    # set edge semantics), so the fake backend gets a deterministic
+    # poison without real crypto
+    return bls.SignatureSet.multiple_pubkeys(_SIG, [], _MSG)
+
+
+def _counter_children(name: str) -> dict:
+    m = metrics.get(name)
+    return {k: c.value for k, c in m.children().items()} if m else {}
+
+
+def _scheduler(**kw) -> VerificationScheduler:
+    kw.setdefault("deadline_ms", 150.0)
+    kw.setdefault("max_batch_sets", 256)
+    kw.setdefault("max_queue_sets", 1024)
+    return VerificationScheduler(**kw).start()
+
+
+def test_bucket_ladder_matches_device_packer():
+    """The scheduler's ladder IS the device packer's ladder — if either
+    changes without the other, fused flush sizes stop landing on device
+    bucket shapes and the recompile bound silently breaks."""
+    from lighthouse_tpu.crypto.device.bls import _round_up
+
+    assert tuple(_round_up.__defaults__[0]) == BUCKET_LADDER
+    for n in (1, 2, 3, 5, 9, 17, 64, 100, 1024, 1500, 4096):
+        assert round_up_bucket(n) == _round_up(n), n
+
+
+def test_multikind_feeders_fuse_into_shared_batches(fake_backend):
+    """>=3 caller kinds submitting concurrently land in ONE fused batch
+    (kind-mix label on the fused-batch counter) and every verdict matches
+    the direct per-caller call."""
+    fused_before = _counter_children(
+        "verification_scheduler_fused_batches_total"
+    )
+    sched = _scheduler()
+    try:
+        futs: list = []
+        lock = threading.Lock()
+        barrier = threading.Barrier(len(KINDS))
+
+        def feeder(kind):
+            barrier.wait()
+            for _ in range(3):
+                f = sched.submit([_set()], kind)
+                with lock:
+                    futs.append(f)
+
+        threads = [
+            threading.Thread(target=feeder, args=(k,)) for k in KINDS
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        results = [f.result(timeout=10) for f in futs]
+    finally:
+        sched.stop()
+    # verdict identity: every submission's direct call agrees
+    assert results == [bls.verify_signature_sets([_set()])] * 9 == [True] * 9
+    st = sched.status()
+    assert st["fused_batches_total"] >= 1
+    # at least one dispatched batch fused MULTIPLE caller kinds
+    fused_after = _counter_children(
+        "verification_scheduler_fused_batches_total"
+    )
+    mixed_delta = sum(
+        v - fused_before.get(k, 0)
+        for k, v in fused_after.items()
+        if "+" in k[0]
+    )
+    assert mixed_delta >= 1, (fused_before, fused_after)
+    # every bucket dispatched sits on the ladder
+    assert all(b in BUCKET_LADDER for b in st["buckets_seen"]), st
+
+
+def test_poisoned_submission_bisected_to_exactly_its_submitter(fake_backend):
+    """One poisoned submission in a fused batch is isolated by
+    split-and-retry: IT verdicts False (same as its direct call), every
+    other caller's submission still verdicts True."""
+    ev_seq = max(
+        (e["seq"] for e in flight_recorder.events(["scheduler_bisection"])),
+        default=-1,
+    )
+    sched = _scheduler()
+    try:
+        good = [sched.submit([_set()], "unaggregated") for _ in range(3)]
+        bad = sched.submit([_poisoned()], "aggregate")
+        more = [sched.submit([_set(2)], "sync_message") for _ in range(2)]
+        assert bad.result(timeout=10) is False
+        assert [f.result(timeout=10) for f in good] == [True] * 3
+        assert [f.result(timeout=10) for f in more] == [True] * 2
+    finally:
+        sched.stop()
+    # identical to the direct calls
+    assert bls.verify_signature_sets([_poisoned()]) is False
+    assert bls.verify_signature_sets([_set()]) is True
+    assert sched.status()["bisections_total"] >= 1
+    if flight_recorder.enabled():
+        new = [
+            e
+            for e in flight_recorder.events(["scheduler_bisection"])
+            if e["seq"] > ev_seq
+        ]
+        assert new, "bisection must journal scheduler_bisection events"
+
+
+def test_deadline_flush_fires_on_lone_submission(fake_backend):
+    """A single submission must not wait for company: the deadline flush
+    dispatches it within the latency budget."""
+    m = metrics.get("verification_scheduler_flushes_total")
+    before = m.with_labels("deadline").value
+    sched = _scheduler(deadline_ms=60.0, max_batch_sets=1024)
+    try:
+        t0 = time.monotonic()
+        ok = sched.submit([_set()], "unaggregated").result(timeout=10)
+        elapsed = time.monotonic() - t0
+    finally:
+        sched.stop()
+    assert ok is True
+    assert 0.02 <= elapsed < 5.0, elapsed  # ~deadline, not the timeout
+    assert m.with_labels("deadline").value >= before + 1
+
+
+def test_bucket_full_flush_beats_the_deadline(fake_backend):
+    """Reaching the bucket ceiling flushes immediately even under a huge
+    deadline."""
+    sched = _scheduler(deadline_ms=60_000.0, max_batch_sets=4)
+    try:
+        t0 = time.monotonic()
+        futs = [sched.submit([_set()], "unaggregated") for _ in range(4)]
+        assert [f.result(timeout=10) for f in futs] == [True] * 4
+        assert time.monotonic() - t0 < 5.0
+        assert sched.status()["buckets_seen"] == [4]
+    finally:
+        sched.stop()
+
+
+def test_explicit_flush_and_shutdown_drain(fake_backend):
+    sched = _scheduler(deadline_ms=60_000.0)
+    try:
+        a = sched.submit([_set()], "unaggregated")
+        b = sched.submit([_set()], "aggregate")
+        sched.flush()
+        assert a.result(timeout=10) is True
+        assert b.result(timeout=10) is True
+        c = sched.submit([_set()], "sync_message")
+    finally:
+        sched.stop()  # drains c
+    assert c.result(timeout=10) is True
+    # post-stop submissions degrade to the synchronous direct call
+    assert sched.submit([_set()], "unaggregated").result(timeout=1) is True
+
+
+def test_empty_submission_matches_direct_semantics(fake_backend):
+    sched = _scheduler()
+    try:
+        assert sched.submit([], "unaggregated").result(timeout=1) is False
+    finally:
+        sched.stop()
+    assert bls.verify_signature_sets([]) is False
+
+
+def test_backpressure_sheds_to_caller_fallback(fake_backend):
+    """A full queue sheds the submission to a synchronous caller-thread
+    verify: verdict unchanged, shed counted + journaled."""
+    ev_seq = max(
+        (e["seq"] for e in flight_recorder.events(["scheduler_shed"])),
+        default=-1,
+    )
+    release = threading.Event()
+
+    def slow_verify(sets):
+        # stall only the FLUSH thread: the shed fallback reuses the same
+        # verify_fn from the caller's thread and must stay fast here
+        if threading.current_thread().name == "verification-scheduler":
+            release.wait(timeout=10)
+        return bls.verify_signature_sets(sets)
+
+    sched = VerificationScheduler(
+        verify_fn=slow_verify, deadline_ms=5.0,
+        max_batch_sets=256, max_queue_sets=2,
+    ).start()
+    try:
+        first = sched.submit([_set(), _set()], "unaggregated")
+        time.sleep(0.1)  # deadline fired; flush thread is inside verify
+        second = sched.submit([_set(), _set()], "aggregate")  # queued
+        t0 = time.monotonic()
+        third = sched.submit([_set()], "sync_message")  # 2+1 > 2: shed
+        # the shed fallback ran synchronously in THIS thread
+        assert third.done() and third.result() is True
+        assert time.monotonic() - t0 < 5.0
+        release.set()
+        assert first.result(timeout=10) is True
+        assert second.result(timeout=10) is True
+    finally:
+        release.set()
+        sched.stop()
+    assert sched.status()["shed_total"] == 1
+    if flight_recorder.enabled():
+        new = [
+            e
+            for e in flight_recorder.events(["scheduler_shed"])
+            if e["seq"] > ev_seq
+        ]
+        assert len(new) == 1 and new[0]["fields"]["kind"] == "sync_message"
+
+
+def test_varying_traffic_shapes_stay_on_the_ladder(fake_backend):
+    """Submissions of ragged sizes flush into ladder buckets only — the
+    bounded-recompile surface (the device compiles one program per
+    padded shape, so #distinct shapes <= #ladder buckets touched)."""
+    sched = _scheduler(deadline_ms=30.0)
+    try:
+        for sizes in ((1,), (2, 1), (3, 3, 3), (5, 4), (1, 1, 1)):
+            futs = [
+                sched.submit([_set() for _ in range(n)], "unaggregated")
+                for n in sizes
+            ]
+            assert all(f.result(timeout=10) for f in futs)
+    finally:
+        sched.stop()
+    st = sched.status()
+    assert st["buckets_seen"], st
+    assert set(st["buckets_seen"]) <= set(BUCKET_LADDER)
+    # 1..9 fused sets can only ever touch ladder buckets {1, 2, 4, 8, 16}
+    assert len(st["buckets_seen"]) <= 5
+
+
+def test_verify_exception_propagates_like_direct_call(fake_backend):
+    """A verify crash on a LEAF (single-submission) call surfaces on that
+    caller's future — its direct call would have raised — and the flush
+    thread survives."""
+
+    calls = [0]
+
+    def exploding(sets):
+        calls[0] += 1
+        if calls[0] == 1:
+            raise RuntimeError("backend fell over")
+        return bls.verify_signature_sets(sets)
+
+    sched = VerificationScheduler(
+        verify_fn=exploding, deadline_ms=30.0,
+        max_batch_sets=256, max_queue_sets=1024,
+    ).start()
+    try:
+        f = sched.submit([_set()], "unaggregated")
+        with pytest.raises(RuntimeError):
+            f.result(timeout=10)
+        # the scheduler still works afterwards
+        assert sched.submit([_set()], "aggregate").result(timeout=10) is True
+    finally:
+        sched.stop()
+
+
+def test_group_verify_exception_isolated_by_bisection(fake_backend):
+    """A crash on a FUSED call (e.g. a transient failure only the larger
+    batch shape hits) must not poison innocent submissions: the group is
+    bisected and each leaf gets its own direct-call verdict."""
+
+    def fused_only_explodes(sets):
+        if len(sets) > 1:
+            raise RuntimeError("only the fused shape fails")
+        return bls.verify_signature_sets(sets)
+
+    sched = VerificationScheduler(
+        verify_fn=fused_only_explodes, deadline_ms=30.0,
+        max_batch_sets=256, max_queue_sets=1024,
+    ).start()
+    try:
+        a = sched.submit([_set()], "unaggregated")
+        b = sched.submit([_set()], "aggregate")
+        assert a.result(timeout=10) is True
+        assert b.result(timeout=10) is True
+    finally:
+        sched.stop()
+    assert sched.status()["bisections_total"] >= 1
+
+
+def test_verdict_identity_with_real_crypto_and_bisection():
+    """Real signatures through the native C backend: fused verdicts ==
+    direct per-caller verdicts, including a tampered submission isolated
+    by bisection. Skips where the box has no C toolchain."""
+    try:
+        backend.set_backend("cpu-native")
+    except Exception:
+        pytest.skip("native C backend unavailable")
+    try:
+        msg = b"\x22" * 32
+        wrong = b"\x33" * 32
+        subs = []
+        for i in range(4):
+            sk = bls.SecretKey(100 + i)
+            pk = bls.PublicKey.deserialize(sk.public_key().serialize())
+            signed = sk.sign(wrong if i == 2 else msg)
+            sig = bls.Signature.deserialize(signed.serialize())
+            subs.append([bls.SignatureSet.single_pubkey(sig, pk, msg)])
+        direct = [bls.verify_signature_sets(s) for s in subs]
+        assert direct == [True, True, False, True]
+
+        sched = _scheduler(deadline_ms=100.0)
+        try:
+            futs = [
+                sched.submit(s, KINDS[i % len(KINDS)])
+                for i, s in enumerate(subs)
+            ]
+            fused = [f.result(timeout=30) for f in futs]
+        finally:
+            sched.stop()
+        assert fused == direct
+        assert sched.status()["bisections_total"] >= 1
+    finally:
+        backend.set_backend("cpu")
+
+
+def test_chain_batch_path_routes_through_scheduler(fake_backend):
+    """End-to-end wiring: a chain carrying a scheduler verifies its
+    gossip attestation batch THROUGH it (sets counter advances) with the
+    same per-item results the direct path produces."""
+    import copy
+
+    from lighthouse_tpu.beacon_chain import BeaconChain
+    from lighthouse_tpu.state_transition import store_replayer
+    from lighthouse_tpu.store import HotColdDB, MemoryStore
+    from lighthouse_tpu.testing import StateHarness
+    from lighthouse_tpu.types import MINIMAL, minimal_spec
+    from lighthouse_tpu.utils.slot_clock import ManualSlotClock
+
+    h = StateHarness(
+        MINIMAL, minimal_spec(), validator_count=16, fork_name="phase0",
+        fake_sign=True,
+    )
+    genesis = copy.deepcopy(h.state)
+    db = HotColdDB(
+        MemoryStore(), h.t, h.spec, store_replayer(h.preset, h.spec),
+        slots_per_snapshot=8,
+    )
+    clock = ManualSlotClock(genesis.genesis_time, h.spec.seconds_per_slot)
+    chain = BeaconChain(h.preset, h.spec, h.t, db, genesis, slot_clock=clock)
+    for slot in (1, 2):
+        clock.set_slot(slot)
+        sb = h.produce_block(slot)
+        h.process_block(sb, strategy="none")
+        chain.process_block(chain.verify_block_for_gossip(sb))
+
+    singles = []
+    for att in h.attestations_for_slot(chain.head_state, 1)[:3]:
+        single = copy.deepcopy(att)
+        bits = list(att.aggregation_bits)
+        single.aggregation_bits = [i == 0 for i in range(len(bits))]
+        singles.append(single)
+    assert singles
+
+    m = metrics.get("verification_scheduler_sets_total")
+    before = m.with_labels("unaggregated").value
+    chain.verification_scheduler = _scheduler(deadline_ms=30.0)
+    try:
+        results = chain.batch_verify_unaggregated_attestations_for_gossip(
+            singles
+        )
+    finally:
+        chain.verification_scheduler.stop()
+        chain.verification_scheduler = None
+    assert all(hasattr(r, "indexed") for r in results), results
+    assert m.with_labels("unaggregated").value > before
+
+
+def test_backend_verify_helper_without_scheduler(fake_backend):
+    """chains without a scheduler (None attribute, or plain objects) get
+    the direct call."""
+
+    class Bare:
+        verification_scheduler = None
+
+    assert backend_verify(Bare(), [_set()], "unaggregated") is True
+    assert backend_verify(object(), [_set()], "unaggregated") is True

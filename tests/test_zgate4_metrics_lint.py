@@ -45,6 +45,7 @@ KNOWN_PREFIXES = (
     "testm_",  # test-only families from tests/test_metrics_depth.py
     "validator_monitor_",
     "vc_",
+    "verification_scheduler_",
 )
 
 _NAME = re.compile(r"[a-z][a-z0-9_]*$")
@@ -63,6 +64,7 @@ def _import_instrumented_modules():
     import lighthouse_tpu.utils.flight_recorder  # noqa: F401
     import lighthouse_tpu.utils.logging  # noqa: F401
     import lighthouse_tpu.utils.monitoring  # noqa: F401
+    import lighthouse_tpu.verification_service.batcher  # noqa: F401
 
 
 def test_registered_names_snake_case_with_known_prefix():
@@ -137,6 +139,36 @@ def test_new_observability_families_registered():
         assert m is not None, f"family {name} not registered"
         assert m.kind == kind, (name, m.kind)
         assert m.labelnames == labels, (name, m.labelnames)
+
+
+def test_verification_scheduler_families_registered():
+    """ISSUE 4 families (verification_service/batcher.py) exist under
+    their declared types + labels."""
+    _import_instrumented_modules()
+    reg = metrics.registry_snapshot()
+    want = {
+        "verification_scheduler_fused_batches_total": ("counter", ("kinds",)),
+        "verification_scheduler_submissions_total": (
+            "counter", ("kind", "outcome"),
+        ),
+        "verification_scheduler_sets_total": ("counter", ("kind",)),
+        "verification_scheduler_flushes_total": ("counter", ("trigger",)),
+        "verification_scheduler_shed_total": ("counter", ("kind",)),
+        "verification_scheduler_bypass_total": ("counter", ("kind",)),
+        "verification_scheduler_batch_occupancy_ratio": ("gauge", None),
+        "verification_scheduler_padding_waste_ratio": ("gauge", None),
+        "verification_scheduler_queue_depth": ("gauge", None),
+        "verification_scheduler_queue_wait_seconds": ("histogram", None),
+        "verification_scheduler_bisections_total": ("counter", None),
+    }
+    for name, (kind, labels) in want.items():
+        m = reg.get(name)
+        assert m is not None, f"family {name} not registered"
+        assert m.kind == kind, (name, m.kind)
+        if labels is not None:
+            assert m.labelnames == labels, (name, m.labelnames)
+        else:
+            assert not hasattr(m, "labelnames"), name  # unlabeled family
 
 
 def test_journal_event_kinds_snake_case_and_documented():

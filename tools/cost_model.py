@@ -247,6 +247,15 @@ def main() -> None:
       "explained from its OWN stage split, not the aggregate histogram — "
       "`tools/forensics_report.py` renders the attribution from a dump "
       "([OBSERVABILITY.md](OBSERVABILITY.md), flight-recorder section).")
+    w("- Amortization lever: the per-batch fixed overhead (pack + dispatch "
+      "+ padded lanes) this model prices is what the verification "
+      "scheduler exists to amortize — it fuses signature sets from MANY "
+      "concurrent callers into one ladder-bucket batch under a latency "
+      "deadline, so real traffic runs at the large-B end of these tables "
+      "instead of one caller's burst size "
+      "([VERIFICATION_SERVICE.md](VERIFICATION_SERVICE.md); occupancy and "
+      "padding-waste gauges in "
+      "[OBSERVABILITY.md](OBSERVABILITY.md)).")
     w("")
     out = REPO / "docs" / "COST_MODEL.md"
     out.write_text("\n".join(lines) + "\n")
