@@ -39,9 +39,10 @@ def _measure_impl(name: str, n: int, depth: int, reps: int) -> dict:
     from jax import lax
 
     from lighthouse_tpu.crypto.device import fp
+    from lighthouse_tpu.crypto import device
 
     fp.set_impl(name)
-    jax.clear_caches()  # fp impl dispatch is trace-time; drop stale kernels
+    device.reset_compiled_state()  # impl dispatch is trace-time; drop stale kernels
 
     rng = np.random.default_rng(0xF9)
     x = jnp.asarray(rng.integers(0, fp.MASK + 1, (n, fp.NL), dtype=np.int32))
@@ -111,6 +112,7 @@ def main() -> None:
         pass
 
     from lighthouse_tpu.crypto.device import fp
+    from lighthouse_tpu.crypto import device
 
     prev = fp.get_impl()
     rows = []
@@ -119,7 +121,7 @@ def main() -> None:
             rows.append(_measure_impl(name.strip(), args.n, args.depth, args.reps))
     finally:
         fp.set_impl(prev)
-        jax.clear_caches()
+        device.reset_compiled_state()
 
     digests = {r["digest"] for r in rows}
     assert len(digests) == 1, f"impls disagree on canonical output: {rows}"

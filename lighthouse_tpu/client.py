@@ -48,6 +48,13 @@ class ClientConfig:
     scheduler_deadline_ms: float = 25.0
     scheduler_max_batch_sets: int = 256
     scheduler_max_queue_sets: int = 2048
+    # AOT warmup + warm-shape routing + persistent executable caching for
+    # the staged device pipeline (compile_service/); only effective with
+    # bls_backend="tpu". None cache dir = LIGHTHOUSE_TPU_COMPILE_CACHE_DIR
+    # env (unset = no persistent cache); empty rungs = the default ladder.
+    compile_service: bool = True
+    compile_cache_dir: Optional[str] = None
+    compile_rungs: tuple = ()
 
 
 class Client:
@@ -83,6 +90,14 @@ class Client:
                 # resolves every queued future, and post-stop submissions
                 # degrade to synchronous direct calls
                 sched.stop()
+            csvc = getattr(self.chain, "compile_service", None)
+            if csvc is not None:
+                # after the scheduler drain: in-flight flushes may still
+                # route through the warm-shape registry
+                from .compile_service import clear_service
+
+                csvc.stop()
+                clear_service(csvc)
             self.processor.shutdown()
             self.persist()
             if self.monitoring is not None:
@@ -316,6 +331,23 @@ class ClientBuilder:
 
             store.put_block(_htr(cp_block.message), cp_block)
 
+        csvc = None
+        if cfg.bls_backend == "tpu" and cfg.compile_service:
+            from .compile_service import CompileService, set_service
+            from .compile_service.service import env_enabled
+
+            if env_enabled():
+                # AOT-warm the staged bucket ladder off the hot path and
+                # route cold-bucket traffic around XLA compiles; also
+                # wires the persistent executable cache into the node so
+                # a restart warm-starts from disk
+                csvc = CompileService(
+                    rungs=cfg.compile_rungs or None,
+                    cache_dir=cfg.compile_cache_dir,
+                ).start()
+                set_service(csvc)  # the seam TpuBackend pads against
+        chain.compile_service = csvc
+
         if cfg.verification_scheduler:
             # the continuous-batching layer: gossip verifiers submit
             # through chain.verification_scheduler and their signature
@@ -326,6 +358,7 @@ class ClientBuilder:
                 deadline_ms=cfg.scheduler_deadline_ms,
                 max_batch_sets=cfg.scheduler_max_batch_sets,
                 max_queue_sets=cfg.scheduler_max_queue_sets,
+                compile_service=csvc,
             ).start()
 
         processor = _build_processor(chain, cfg.n_workers)

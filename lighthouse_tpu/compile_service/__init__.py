@@ -1,0 +1,29 @@
+"""Compile service: ahead-of-time warmup, warm-shape routing, and
+persistent executable caching for the staged device BLS pipeline (see
+``service.py`` for the design, ``docs/COMPILE_SERVICE.md`` for the
+operator view). The verification scheduler routes cold-bucket flushes
+through :meth:`CompileService.decide_flush`; the device backend pads
+batches up to warm rungs via :meth:`CompileService.pads_for`;
+``tools/warmup.py`` prebakes the persistent cache."""
+
+from .service import (
+    DEFAULT_RUNGS,
+    CompileService,
+    WarmShapeRegistry,
+    clear_service,
+    get_active_service,
+    get_service,
+    invalidate_registry,
+    set_service,
+)
+
+__all__ = [
+    "DEFAULT_RUNGS",
+    "CompileService",
+    "WarmShapeRegistry",
+    "clear_service",
+    "get_active_service",
+    "get_service",
+    "invalidate_registry",
+    "set_service",
+]

@@ -1,0 +1,229 @@
+"""Persistent executable caching for the compile service.
+
+Two cooperating layers:
+
+* the **JAX persistent compilation cache** (``jax_compilation_cache_dir``)
+  holds the compiled executables themselves — a restarted node's AOT
+  warmup walk finds every staged program on disk and "compiles" in
+  milliseconds instead of minutes (``bench.py`` already proved this for
+  the bench harness; this module wires the same machinery into the node
+  proper). Feature-detected: older/stripped jax builds without the
+  config knob degrade to no persistence, loudly reported in
+  :func:`enable_persistent_cache`'s return value rather than raised.
+* a **manifest** (``manifest.json`` next to the cache entries) records
+  WHICH rungs were baked under WHICH environment, keyed on
+  backend platform | jax version | device-code hash | fp_impl |
+  (B, K, M) | stage. The executables alone cannot answer "is this cache
+  warm for ME?" — the manifest can, and a key mismatch (engine switch,
+  device-code edit, jax upgrade) is a MISS by construction, so a stale
+  bake can never masquerade as a warm start
+  (``tests/test_compile_service.py`` pins the invalidation).
+
+Known failure mode (documented in ``tests/conftest.py`` and
+``docs/COMPILE_SERVICE.md``): on some CPU host families XLA:CPU AOT
+cache entries round-trip with mismatched machine features and SIGSEGV
+on load. The node therefore only enables the cache when a directory is
+explicitly configured (``LIGHTHOUSE_TPU_COMPILE_CACHE_DIR`` or
+``ClientConfig.compile_cache_dir``) — never by default.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+
+ENV_CACHE_DIR = "LIGHTHOUSE_TPU_COMPILE_CACHE_DIR"
+MANIFEST_NAME = "manifest.json"
+MANIFEST_SCHEMA = "lighthouse_tpu.compile_manifest/1"
+
+# The device modules whose source defines the staged programs: an edit
+# to any of them changes the emitted HLO, so it must change the cache
+# key. Order is part of the hash input (kept sorted).
+_CODE_MODULES = (
+    "bls", "curve", "fp", "fp2", "htc", "pairing", "pallas_fp", "tower",
+)
+
+
+def resolve_cache_dir(explicit: str | None = None) -> str | None:
+    """The configured cache directory: explicit arg wins, then the env
+    knob; None means persistent caching stays OFF (the safe default —
+    see the SIGSEGV note in the module docstring)."""
+    return explicit or os.environ.get(ENV_CACHE_DIR) or None
+
+
+def enable_persistent_cache(cache_dir: str, min_compile_time_s: float = 1.0) -> dict:
+    """Point the in-process JAX persistent compilation cache at
+    ``cache_dir``. Feature-detected, never raises: returns
+    ``{enabled, dir, reason}`` where ``reason`` explains a False."""
+    try:
+        import jax
+
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", min_compile_time_s
+        )
+    except Exception as e:  # missing knob / read-only dir / old jax
+        return {"enabled": False, "dir": cache_dir, "reason": repr(e)[:200]}
+    return {"enabled": True, "dir": cache_dir, "reason": None}
+
+
+_code_hash: str | None = None
+
+
+def code_version_hash() -> str:
+    """Hash of the device crypto sources that define the staged
+    programs (12 hex chars). Any edit to them invalidates every
+    manifest key — the executables in the jax cache key on the real HLO
+    fingerprint; the manifest must be at least as conservative. The
+    sources cannot change under a running process, so the hash is
+    computed once and memoized — ``environment_key`` sits on the
+    /lighthouse/health scrape path."""
+    global _code_hash
+    if _code_hash is None:
+        import lighthouse_tpu.crypto.device as _device
+
+        h = hashlib.sha256()
+        base = os.path.dirname(os.path.abspath(_device.__file__))
+        for mod in _CODE_MODULES:
+            path = os.path.join(base, mod + ".py")
+            try:
+                with open(path, "rb") as f:
+                    h.update(f.read())
+            except OSError:
+                h.update(b"missing:" + mod.encode())
+        _code_hash = h.hexdigest()[:12]
+    return _code_hash
+
+
+def environment_key(
+    fp_impl: str,
+    platform: str | None = None,
+    jax_version: str | None = None,
+    code_hash: str | None = None,
+) -> str:
+    """The environment half of a manifest key. The defaults describe
+    THIS process (lazily querying jax); tests inject explicit parts to
+    pin the invalidation semantics without a backend."""
+    if platform is None or jax_version is None:
+        import jax
+
+        platform = platform or jax.default_backend()
+        jax_version = jax_version or jax.__version__
+    code_hash = code_hash or code_version_hash()
+    return f"{platform}|jax-{jax_version}|code-{code_hash}|{fp_impl}"
+
+
+def manifest_key(env_key: str, stage: str, b: int, k: int, m: int) -> str:
+    return f"{env_key}|B{b}K{k}M{m}|{stage}"
+
+
+def executable_entries(cache_dir: str) -> set | None:
+    """``(name, mtime_ns)`` of the executable entries currently in
+    ``cache_dir`` (the manifest and atomic-write temp files excluded);
+    None when the dir is unreadable. The before/after probe both the
+    service's AOT walk and the warmup CLI use to keep the manifest at
+    least as conservative as the cache. Snapshotting mtimes (not just
+    names) lets a re-warm over an already-baked cache count as
+    persisted when the load path touches its entries — a manifest lost
+    after a successful bake can then be rebuilt without wiping the
+    cache."""
+    try:
+        with os.scandir(cache_dir) as it:
+            return {
+                (e.name, e.stat().st_mtime_ns)
+                for e in it
+                if e.name != MANIFEST_NAME and not e.name.endswith(".tmp")
+            }
+    except OSError:
+        return None
+
+
+def persisted_after(cache_dir: str, before: set | None, any_fresh: bool) -> bool:
+    """Did a compile walk actually involve the executable cache? True
+    unless a FRESH compile left the cache dir byte-for-byte untouched —
+    no new entries AND no existing entry touched — which is what a
+    silent write failure looks like. Conservative residual: a cache-
+    served re-warm whose load path touches nothing reads as
+    not-persisted, so a lost manifest may stay unreported until a fresh
+    bake (warm-start claims err cold, never warm)."""
+    if before is None or not any_fresh:
+        return True
+    after = executable_entries(cache_dir)
+    return after is None or bool(after - before)
+
+
+class Manifest:
+    """Thread-safe record of baked rungs, persisted as one JSON file in
+    the cache directory. ``has(key)`` answers warm-start questions;
+    ``add(key)`` is called by the compile worker after each successful
+    stage compile. A missing/corrupt file reads as empty (a lost
+    manifest only costs re-warming, never correctness)."""
+
+    def __init__(self, cache_dir: str):
+        self.path = os.path.join(cache_dir, MANIFEST_NAME)
+        self._lock = threading.Lock()
+        self._entries: dict = {}
+        self._load()
+
+    def _load(self) -> None:
+        try:
+            with open(self.path) as f:
+                doc = json.load(f)
+            if doc.get("schema") == MANIFEST_SCHEMA:
+                self._entries = dict(doc.get("entries", {}))
+        except (OSError, ValueError):
+            self._entries = {}
+
+    def _save_locked(self) -> None:
+        doc = {"schema": MANIFEST_SCHEMA, "entries": self._entries}
+        tmp = self.path + ".tmp"
+        try:
+            os.makedirs(os.path.dirname(self.path), exist_ok=True)
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=1, sort_keys=True)
+            os.replace(tmp, self.path)  # atomic: readers never see a torn file
+        except OSError:
+            pass  # best-effort: the jax cache still holds the executables
+
+    def has(self, key: str) -> bool:
+        with self._lock:
+            return key in self._entries
+
+    def add(self, key: str, **meta) -> None:
+        self.add_many((key,), **meta)
+
+    def add_many(self, keys, **meta) -> None:
+        """Record several keys in ONE file rewrite — a rung's readiness
+        adds its three stage keys together, so per-key ``add`` would
+        fsync-replace the whole manifest three times back to back."""
+        with self._lock:
+            for key in keys:
+                self._entries[key] = dict(meta)
+            self._save_locked()
+
+    def entries(self) -> dict:
+        with self._lock:
+            return dict(self._entries)
+
+    def prebaked_rungs(self, env_key: str, stages=("stage1", "stage2", "stage3")) -> list:
+        """Rungs (B, K, M) whose EVERY stage is recorded under
+        ``env_key`` — the rungs a restarted node re-warms from disk with
+        zero fresh XLA work."""
+        prefix = env_key + "|"
+        with self._lock:
+            shapes: dict = {}
+            for key in self._entries:
+                if not key.startswith(prefix):
+                    continue
+                try:
+                    shape_part, stage = key[len(prefix):].split("|")
+                    b, rest = shape_part[1:].split("K")
+                    k, m = rest.split("M")
+                    rung = (int(b), int(k), int(m))
+                except ValueError:
+                    continue
+                shapes.setdefault(rung, set()).add(stage)
+        return sorted(r for r, st in shapes.items() if st >= set(stages))

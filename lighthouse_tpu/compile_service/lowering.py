@@ -1,0 +1,188 @@
+"""Canonical lowering/warmup helpers for the staged device BLS programs.
+
+ONE definition of the staged programs' argument shapes at a bucket rung
+(B, K, M), shared by every consumer that needs "the programs the node
+actually dispatches":
+
+* the compile-budget gate (``tools/hlo_stats.py`` ->
+  ``tests/test_zgate2_compile_budget.py``) lowers them to count HLO
+  instructions;
+* the compile profilers (``tools/profile_compile.py`` /
+  ``profile_compile2.py``) time lower+compile on them;
+* the :class:`~lighthouse_tpu.compile_service.service.CompileService`
+  warms them ahead of traffic (:func:`warm_staged`).
+
+Before this module each consumer rebuilt the shapes by hand, so the
+budgets could silently drift from what the service compiled and the
+node served. Now drift is a merge conflict.
+
+No jax at import time: every helper imports lazily so the package can be
+imported (for plans, metrics lint, ``tools/warmup.py --dry-run``)
+without initializing a backend.
+"""
+
+from __future__ import annotations
+
+import time
+
+STAGES = ("stage1", "stage2", "stage3")
+
+
+class StageWarmupError(RuntimeError):
+    """One stage of a rung warmup failed. Carries WHICH stage raised and
+    the per-stage records of the stages that had already succeeded, so
+    the compile service can count `ok` for real work done and `error`
+    only for the stage that actually failed."""
+
+    def __init__(self, stage: str, partial: dict, cause: BaseException):
+        super().__init__(f"{stage}: {cause!r}")
+        self.stage = stage
+        self.partial = partial
+        self.__cause__ = cause
+
+
+def hlo_instruction_count(lowered_or_text) -> int:
+    """SSA assignments in a lowered program's StableHLO text. Accepts the
+    lowered object or its pre-rendered ``as_text()`` string (rendering a
+    100k-line program is itself expensive — callers that also need line
+    counts should render once and pass the text)."""
+    try:
+        text = (
+            lowered_or_text
+            if isinstance(lowered_or_text, str)
+            else lowered_or_text.as_text()
+        )
+        return sum(1 for ln in text.splitlines() if " = " in ln)
+    except Exception:
+        return -1
+
+
+def staged_dummy_args(B: int, K: int, M: int) -> dict:
+    """Zero-filled device arrays matching EXACTLY the (shape, dtype)
+    signatures ``verify_batch_raw_staged`` dispatches at bucket rung
+    (B, K, M) — the signatures ``bls._run_stage`` keys its recompile
+    accounting on."""
+    import jax.numpy as jnp
+
+    from ..crypto.device import fp
+
+    return {
+        "stage1": (
+            jnp.zeros((B, 2, fp.NL), jnp.int32),      # sig_x
+            jnp.zeros((B,), bool),                     # sig_larger
+            jnp.zeros((M, 2, 2, fp.NL), jnp.int32),    # msg_u
+        ),
+        "stage2": (
+            jnp.zeros((B, K, 2, fp.NL), jnp.int32),    # pk_xy
+            jnp.zeros((B, K), bool),                   # pk_mask
+            jnp.zeros((B, 2, 2, fp.NL), jnp.int32),    # sig_xy
+            jnp.zeros((B, 2), jnp.int32),              # rand
+            jnp.zeros((B,), bool),                     # set_mask
+        ),
+        "stage3": (
+            jnp.zeros((B, fp.NL), jnp.int32),          # pk_x
+            jnp.zeros((B, fp.NL), jnp.int32),          # pk_y
+            jnp.zeros((B,), bool),                     # pk_inf
+            jnp.zeros((B, 2, fp.NL), jnp.int32),       # msg_aff_x
+            jnp.zeros((B, 2, fp.NL), jnp.int32),       # msg_aff_y
+            jnp.zeros((B,), bool),                     # msg_aff_inf
+            jnp.zeros((2, fp.NL), jnp.int32),          # acc_x
+            jnp.zeros((2, fp.NL), jnp.int32),          # acc_y
+            jnp.zeros((), bool),                       # acc_inf
+        ),
+    }
+
+
+def staged_programs(B: int, K: int, M: int) -> dict:
+    """``{stage: (unjitted_fn, dummy_args)}`` for fresh lowering (the
+    budget gate and profilers jit these themselves to measure)."""
+    from ..crypto.device import bls as dbls
+
+    args = staged_dummy_args(B, K, M)
+    fns = {
+        "stage1": dbls._stage1_fn,
+        "stage2": dbls._stage2_fn,
+        "stage3": dbls._stage3_fn,
+    }
+    return {s: (fns[s], args[s]) for s in STAGES}
+
+
+def staged_jitted() -> dict:
+    """The module-level jitted stage callables the node dispatches —
+    warming THESE (not fresh ``jax.jit`` wrappers) is what populates the
+    dispatch cache real traffic hits."""
+    from ..crypto.device import bls as dbls
+
+    return {
+        "stage1": dbls._stage1,
+        "stage2": dbls._stage2,
+        "stage3": dbls._stage3,
+    }
+
+
+def timed_lower_compile(fn, args, compile: bool = True) -> dict:
+    """Shared profiler clock body: jit-lower ``fn`` on ``args`` and
+    (optionally) compile, timing both phases and sizing the emitted
+    StableHLO. Returns ``{lower_s, compile_s, hlo_lines, hlo_instr}``
+    (``compile_s`` None when ``compile=False``; sizes -1 when the text
+    render fails)."""
+    import jax
+
+    t0 = time.perf_counter()
+    lowered = jax.jit(fn).lower(*args)
+    lower_s = time.perf_counter() - t0
+    try:
+        text = lowered.as_text()  # rendered ONCE; both sizes come from it
+        hlo_lines = len(text.splitlines())
+        hlo_instr = hlo_instruction_count(text)
+    except Exception:
+        hlo_lines = hlo_instr = -1
+    compile_s = None
+    if compile:
+        t1 = time.perf_counter()
+        lowered.compile()
+        compile_s = time.perf_counter() - t1
+    return {
+        "lower_s": lower_s,
+        "compile_s": compile_s,
+        "hlo_lines": hlo_lines,
+        "hlo_instr": hlo_instr,
+    }
+
+
+def staged_instruction_counts(B: int, K: int, M: int) -> dict:
+    """Lower (no compile) the three staged programs at bucket rung
+    (B, K, M) and return ``{stage: {instructions, lower_s}}`` — the
+    compile-budget gate's measurement."""
+    out = {}
+    for name, (fn, args) in staged_programs(B, K, M).items():
+        rec = timed_lower_compile(fn, args, compile=False)
+        out[name] = {
+            "instructions": rec["hlo_instr"],
+            "lower_s": round(rec["lower_s"], 2),
+        }
+    return out
+
+
+def warm_staged(B: int, K: int, M: int) -> dict:
+    """Warm the staged pipeline at rung (B, K, M) under the ACTIVE fp
+    impl: dispatch each module-level jitted stage on zero-filled dummy
+    args THROUGH ``bls._run_stage``, so the jit dispatch cache, the
+    persistent compile cache (when configured), the per-stage latency
+    histogram and the recompile counter all see exactly what real
+    traffic at this rung will see — a warmed signature is then NOT fresh
+    for the first real batch. Returns ``{stage: {seconds, fresh}}``."""
+    from ..crypto.device import bls as dbls
+
+    args = staged_dummy_args(B, K, M)
+    jitted = staged_jitted()
+    out = {}
+    for stage in STAGES:
+        try:
+            _, elapsed, fresh = dbls._run_stage(
+                stage, jitted[stage], *args[stage]
+            )
+        except Exception as e:
+            raise StageWarmupError(stage, out, e)
+        out[stage] = {"seconds": elapsed, "fresh": fresh}
+    return out

@@ -1,0 +1,720 @@
+"""CompileService: ahead-of-time warmup, warm-shape routing, and
+persistent executable caching for the staged device BLS pipeline.
+
+The headline bench pays a ~120 s XLA warmup compile before the FIRST
+staged verify at a fresh bucket shape (BENCH_r05), and before this
+module the node had no defense: the verification scheduler fuses
+cross-caller traffic onto the bucket ladder, but the first flush onto a
+*cold* rung blocked a gossip-hot thread on a multi-minute compile while
+queues backed up. Serving stacks solve this with ahead-of-time
+compilation and shape-aware routing — the same pattern that makes
+fixed-function pipelines viable on AI ASICs ("Enabling AI ASICs for
+Zero Knowledge Proof", PAPERS.md) and that amortizes batch-verification
+setup cost in committee-based consensus (arxiv 2302.00418). This module
+is that layer:
+
+* **AOT warmup** — a bounded background worker walks the bucket ladder
+  under the active ``fp_impl`` in priority order at client startup and
+  warms the staged programs off the hot path
+  (:func:`~lighthouse_tpu.compile_service.lowering.warm_staged`: the
+  REAL module-level jitted stage callables, dispatched through
+  ``bls._run_stage`` so every cache and counter sees exactly what
+  traffic will see), maintaining a thread-safe warm-shape registry.
+* **Warm-shape routing** — :meth:`CompileService.route` answers "can
+  rung (B, K, M) dispatch without compiling?": ``warm`` (exact bucket
+  compiled), ``padded`` (a larger warm rung covers it — pad up), or
+  ``shed`` (nothing warm — the scheduler serves the flush via the
+  counted synchronous CPU-native fallback while the cold rung compiles
+  in the background). A cold rung never stalls a flush.
+* **Persistent executable caching** — when a cache directory is
+  configured (``LIGHTHOUSE_TPU_COMPILE_CACHE_DIR`` /
+  ``ClientConfig.compile_cache_dir``) the JAX persistent compilation
+  cache plus a manifest (see :mod:`.cache`) make a restarted node's
+  warmup walk hit disk instead of XLA: zero fresh staged compiles on
+  warm start, prebaked by ``tools/warmup.py``.
+
+The module imports no jax at import time (the metrics lint imports it
+on a box that must not initialize a backend); everything device-shaped
+is imported lazily.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Callable, Iterable, Optional, Sequence, Tuple
+
+from ..utils import flight_recorder, metrics, tracing
+from ..verification_service import round_up_bucket
+from . import cache as _cache
+
+Rung = Tuple[int, int, int]  # (B, K, M) padded bucket shape
+
+# Ladder walk order (priority): the gossip-aggregate headline bucket
+# first (the 120 s problem in BENCH_r05), then the scheduler's large
+# fused bucket, then descending rungs for trickle/single-set traffic.
+# K=16/M=8 are the bench headline pads (committee sets pad K up; the
+# message-dedup plane rarely exceeds 8 uniques per flush).
+DEFAULT_RUNGS: Tuple[Rung, ...] = (
+    (64, 16, 8),
+    (256, 16, 8),
+    (16, 16, 8),
+    (4, 16, 8),
+    (1, 16, 8),
+)
+
+_ENV_ENABLED = "LIGHTHOUSE_TPU_COMPILE_SERVICE"
+_ENV_RUNGS = "LIGHTHOUSE_TPU_COMPILE_RUNGS"
+
+_COMPILE_BUCKETS = (
+    0.01, 0.05, 0.25, 1.0, 5.0, 15.0, 30.0, 60.0, 120.0, 300.0, 600.0, 1200.0,
+)
+
+_IN_FLIGHT = metrics.gauge(
+    "compile_service_compiles_in_flight",
+    "staged-program compiles the background worker is running right now",
+)
+_WARM_RUNGS = metrics.gauge(
+    "compile_service_warm_rungs",
+    "bucket rungs (B, K, M) x fp_impl whose three staged programs are "
+    "compiled and routable",
+)
+_QUEUE_DEPTH = metrics.gauge(
+    "compile_service_queue_depth",
+    "bucket rungs queued for background compilation",
+)
+_COMPILES = metrics.counter_vec(
+    "compile_service_compiles_total",
+    "per-stage AOT warmup compiles by outcome (ok includes "
+    "persistent-cache hits — those are compiles XLA served from disk)",
+    ("stage", "outcome"),
+)
+_COMPILE_SECONDS = metrics.histogram_vec(
+    "compile_service_compile_seconds",
+    "per-stage AOT warmup wall time per rung (a persistent-cache hit is "
+    "the sub-second mode; a fresh XLA compile the minutes mode)",
+    ("stage",),
+    buckets=_COMPILE_BUCKETS,
+)
+_COLD_ROUTES = metrics.counter_vec(
+    "compile_service_cold_routes_total",
+    "scheduler flushes that arrived at a cold bucket: padded = served "
+    "on a larger warm rung, shed = served via the synchronous CPU-native "
+    "fallback while the rung compiles in the background",
+    ("action",),
+)
+
+
+def _env_rungs() -> Optional[Tuple[Rung, ...]]:
+    """Parse LIGHTHOUSE_TPU_COMPILE_RUNGS=\"B:K:M,B:K:M\"; None when unset
+    or malformed (malformed falls back to the default plan, loudly)."""
+    raw = os.environ.get(_ENV_RUNGS)
+    if not raw:
+        return None
+    try:
+        rungs = tuple(
+            tuple(int(p) for p in chunk.split(":"))
+            for chunk in raw.split(",")
+            if chunk.strip()
+        )
+        if rungs and all(len(r) == 3 and all(v > 0 for v in r) for r in rungs):
+            return rungs  # type: ignore[return-value]
+    except ValueError:
+        pass
+    from ..utils import logging as tlog
+
+    tlog.log("warn", "malformed LIGHTHOUSE_TPU_COMPILE_RUNGS ignored", raw=raw[:80])
+    return None
+
+
+def _geometry(sets) -> Tuple[int, int, int]:
+    """(n_sets, max pubkeys/set, unique messages) of a flush — the three
+    padded dims the packers derive, computed WITHOUT importing the
+    device stack. Items are SignatureSet objects or (sig, pks, msg)
+    triples; anything else conservatively counts as a 1-pubkey set with
+    its own message (over-reserving only risks extra padding)."""
+    n = 0
+    k = 1
+    msgs = set()
+    distinct = 0
+    for item in sets:
+        n += 1
+        keys = getattr(item, "signing_keys", None)
+        msg = getattr(item, "message", None)
+        if keys is None and isinstance(item, (tuple, list)) and len(item) == 3:
+            keys, msg = item[1], item[2]
+        if keys is not None:
+            k = max(k, len(keys) or 1)
+        if msg is not None:
+            try:
+                msgs.add(bytes(msg))
+            except (TypeError, ValueError):
+                distinct += 1
+        else:
+            distinct += 1
+    return n, k, max(1, len(msgs) + distinct)
+
+
+class WarmShapeRegistry:
+    """Thread-safe set of (B, K, M, fp_impl) rungs whose staged programs
+    are compiled. ``invalidate()`` bumps an epoch so an in-flight compile
+    that started before e.g. an ``fp.set_impl`` switch +
+    ``device.reset_compiled_state()`` cannot resurrect a stale rung."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._warm: set = set()
+        self._epoch = 0
+
+    @property
+    def epoch(self) -> int:
+        with self._lock:
+            return self._epoch
+
+    def mark_ready(self, rung: Rung, impl: str, epoch: int | None = None) -> bool:
+        """Record ``rung`` warm under ``impl``; False when the mark is
+        stale (epoch advanced since the compile started) or already
+        present."""
+        with self._lock:
+            if epoch is not None and epoch != self._epoch:
+                return False
+            key = (*rung, impl)
+            if key in self._warm:
+                return False
+            self._warm.add(key)
+            _WARM_RUNGS.set(len(self._warm))
+            return True
+
+    def is_warm(self, rung: Rung, impl: str) -> bool:
+        with self._lock:
+            return (*rung, impl) in self._warm
+
+    def best_covering(
+        self, n_sets: int, k_req: int, m_req: int, impl: str
+    ) -> Optional[Rung]:
+        """Cheapest warm rung that can hold the request padded up
+        (B >= n_sets, K >= k_req, M >= m_req), minimizing padded device
+        work (B*K first). None when nothing warm covers it."""
+        with self._lock:
+            cands = [
+                (b, k, m)
+                for (b, k, m, i) in self._warm
+                if i == impl and b >= n_sets and k >= k_req and m >= m_req
+            ]
+        if not cands:
+            return None
+        return min(cands, key=lambda r: (r[0] * r[1], r[0], r[1], r[2]))
+
+    def warm_rungs(self) -> list:
+        with self._lock:
+            return sorted(self._warm)
+
+    def invalidate(self) -> None:
+        with self._lock:
+            self._warm.clear()
+            self._epoch += 1
+            _WARM_RUNGS.set(0)
+
+
+class CompileService:
+    """Background AOT compiler + warm-shape router for the staged device
+    BLS pipeline (see module docstring). ``compile_rung_fn`` and
+    ``fallback_verify_fn`` are injectable for tests; the defaults are
+    :func:`lowering.warm_staged` and a CPU-native (falling back to
+    CPU-oracle) ``verify_signature_sets``."""
+
+    def __init__(
+        self,
+        rungs: Optional[Iterable[Rung]] = None,
+        cache_dir: str | None = None,
+        compile_rung_fn: Optional[Callable[[int, int, int], dict]] = None,
+        fallback_verify_fn: Optional[Callable[[list], bool]] = None,
+    ):
+        self.plan: Tuple[Rung, ...] = tuple(
+            tuple(r) for r in (rungs or _env_rungs() or DEFAULT_RUNGS)
+        )
+        self.cache_dir = _cache.resolve_cache_dir(cache_dir)
+        self.cache_status: dict = {"enabled": False, "dir": None, "reason": "unconfigured"}
+        self.manifest: Optional[_cache.Manifest] = None
+        self._compile_rung_fn = compile_rung_fn
+        self._fallback_fn = fallback_verify_fn
+        self._fallback_backend = None
+        self.registry = WarmShapeRegistry()
+        self._cv = threading.Condition()
+        self._queue: deque = deque()
+        self._queued: set = set()
+        self._in_flight: Optional[Rung] = None
+        self._stopped = True
+        self._thread: Optional[threading.Thread] = None
+        self._compiled_total = 0
+        self._failed_total = 0
+        self._cold_routes = {"padded": 0, "shed": 0}
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> "CompileService":
+        with self._cv:
+            if self._thread is not None and self._thread.is_alive():
+                return self
+            if self.cache_dir:
+                # min_compile_time 0: jax's default 1 s floor would skip
+                # persisting the small rungs' sub-second compiles while
+                # _record_ready still wrote their manifest entries — a
+                # warm-start claim with no executables behind it
+                self.cache_status = _cache.enable_persistent_cache(
+                    self.cache_dir, min_compile_time_s=0.0
+                )
+                # manifest only over a LIVE cache: entries written while
+                # the jax knob is missing/broken would claim a warm start
+                # that holds no executables (warm_warmup_s == cold)
+                if self.cache_status["enabled"]:
+                    self.manifest = _cache.Manifest(self.cache_dir)
+            for rung in self.plan:
+                self._enqueue_locked(rung, front=False)
+            self._stopped = False
+            self._thread = threading.Thread(
+                target=self._loop, name="compile-service", daemon=True
+            )
+            self._thread.start()
+            # wake any SUPERSEDED worker blocked in _cv.wait() so it can
+            # observe it is no longer self._thread and exit
+            self._cv.notify_all()
+        return self
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stopped = True
+            self._cv.notify_all()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=10)
+        self._thread = None
+
+    def active(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive() and not self._stopped
+
+    def invalidate(self) -> None:
+        """Drop every warm rung (the ``device.reset_compiled_state()``
+        hook: jit caches are gone, so the registry must not keep routing
+        to shapes that would now recompile) and re-queue the configured
+        plan so the background worker re-warms under the new state."""
+        self.registry.invalidate()
+        with self._cv:
+            self._queue.clear()
+            self._queued.clear()
+            for rung in self.plan:
+                # even_in_flight: a rung compiling RIGHT NOW finishes
+                # against the old epoch (its mark_ready is stale), so it
+                # must be queued again or it would stay cold until
+                # traffic demand-pages it
+                self._enqueue_locked(rung, front=False, even_in_flight=True)
+            self._cv.notify_all()
+
+    # -- queueing ---------------------------------------------------------
+
+    def _enqueue_locked(
+        self, rung: Rung, front: bool, even_in_flight: bool = False
+    ) -> None:
+        if rung in self._queued:
+            # already queued: a demand-paged request (front=True) still
+            # PROMOTES it — live traffic's shape must compile next, not
+            # wait behind the remaining plan walk
+            if front and self._queue and self._queue[0] != rung:
+                self._queue.remove(rung)
+                self._queue.appendleft(rung)
+            return
+        if rung == self._in_flight and not even_in_flight:
+            return
+        self._queued.add(rung)
+        if front:
+            self._queue.appendleft(rung)
+        else:
+            self._queue.append(rung)
+        _QUEUE_DEPTH.set(len(self._queue))
+        self._cv.notify()
+
+    def request(self, b: int, k: int, m: int) -> None:
+        """Ask the background worker to compile rung (b, k, m) next —
+        demand-paged warming for traffic the configured plan missed."""
+        with self._cv:
+            self._enqueue_locked((int(b), int(k), int(m)), front=True)
+
+    # -- routing ----------------------------------------------------------
+
+    @staticmethod
+    def _impl() -> str:
+        from ..crypto.device import fp
+
+        return fp.get_impl()
+
+    def route(self, n_sets: int, k_req: int = 1, m_req: int = 1) -> dict:
+        """Routing decision for a flush of ``n_sets`` sets with up to
+        ``k_req`` pubkeys/set and ``m_req`` distinct messages:
+        ``{"action": warm|padded|shed, "rung": (B,K,M)|None,
+        "exact": (B,K,M), "fp_impl": impl}``. Pure registry read —
+        counting/journaling belongs to :meth:`decide_flush`."""
+        impl = self._impl()
+        exact = (
+            round_up_bucket(n_sets),
+            round_up_bucket(k_req),
+            round_up_bucket(m_req),
+        )
+        if self.registry.is_warm(exact, impl):
+            return {"action": "warm", "rung": exact, "exact": exact, "fp_impl": impl}
+        covering = self.registry.best_covering(n_sets, k_req, m_req, impl)
+        if covering is not None:
+            return {
+                "action": "padded", "rung": covering, "exact": exact,
+                "fp_impl": impl,
+            }
+        return {"action": "shed", "rung": None, "exact": exact, "fp_impl": impl}
+
+    def decide_flush(self, sets, caller: str = "flush") -> dict:
+        """The scheduler-facing entry: route the flush, account cold
+        buckets (``compile_service_cold_routes_total``, ``cold_route``
+        journal event) and queue the exact rung for background
+        compilation so the NEXT flush of this shape runs on device."""
+        n, k, m = _geometry(sets)
+        decision = self.route(n, k, m)
+        if decision["action"] == "padded" and get_active_service() is not self:
+            # the pad-up itself happens inside the device backend, which
+            # consults the process-global seam (set_service) — a service
+            # injected into the scheduler but never registered there
+            # cannot deliver it, and claiming "padded" would send the
+            # flush into the exact cold-compile stall the route promises
+            # to avoid. Downgrade to shed: the fallback never stalls.
+            decision = {
+                "action": "shed",
+                "rung": None,
+                "exact": decision["exact"],
+                "fp_impl": decision["fp_impl"],
+            }
+        if decision["action"] != "warm":
+            action = decision["action"]
+            with self._cv:  # flush thread AND verify_now caller threads
+                self._cold_routes[action] += 1
+            _COLD_ROUTES.with_labels(action).inc()
+            eb, ek, em = decision["exact"]
+            rung = decision["rung"]
+            flight_recorder.record(
+                "cold_route",
+                action=action,
+                caller=caller,
+                n_sets=n,
+                k_req=k,
+                m_req=m,
+                exact_b=eb, exact_k=ek, exact_m=em,
+                warm_b=None if rung is None else rung[0],
+                warm_k=None if rung is None else rung[1],
+                warm_m=None if rung is None else rung[2],
+                fp_impl=decision["fp_impl"],
+            )
+            self.request(eb, ek, em)
+        return decision
+
+    def pads_for(self, n_sets: int, k_req: int, m_req: int) -> Optional[Rung]:
+        """Pad target for the device packers: the warm rung a
+        warm/padded route lands on, or None when nothing warm covers the
+        request (the packers then use their default round-up — the
+        pre-service behavior)."""
+        decision = self.route(n_sets, k_req, m_req)
+        return decision["rung"]
+
+    # -- fallback ---------------------------------------------------------
+
+    def fallback_verify(self, sets) -> bool:
+        """Synchronous CPU verification for shed flushes: CPU-native (the
+        C backend) when buildable, the pure-Python oracle otherwise.
+        Verdict-identical to the device call by the backend-differential
+        invariant the whole test suite pins — including the device
+        backend's infinity pre-screens, and exceptions PROPAGATE like the
+        direct call's would (the scheduler's bisection delivers them to
+        exactly the leaf submission that caused them)."""
+        with tracing.span("compile_service.fallback_verify", n_sets=len(sets)):
+            if self._fallback_fn is not None:
+                return bool(self._fallback_fn(list(sets)))
+            from ..crypto import bls as _bls
+
+            prepared = []
+            for item in sets:
+                if isinstance(item, _bls.SignatureSet):
+                    if not item.signing_keys or item.signature.is_infinity():
+                        return False
+                    if any(pk.point.is_infinity() for pk in item.signing_keys):
+                        return False
+                    prepared.append(
+                        (
+                            item.signature,
+                            [pk.point for pk in item.signing_keys],
+                            item.message,
+                        )
+                    )
+                else:
+                    prepared.append(item)
+            return bool(
+                self._fallback_backend_inst().verify_signature_sets(prepared)
+            )
+
+    def _fallback_backend_inst(self):
+        if self._fallback_backend is None:
+            from ..crypto import backend as _backend
+
+            try:
+                self._fallback_backend = _backend._REGISTRY["cpu-native"]()
+            except Exception:  # no C toolchain: the oracle is always there
+                self._fallback_backend = _backend.CpuBackend()
+        return self._fallback_backend
+
+    # -- warmth notification ---------------------------------------------
+
+    def note_rung_verified(
+        self, b: int, k: int, m: int, epoch: int | None = None
+    ) -> None:
+        """Organic warmth: a staged verify at (b, k, m) just succeeded on
+        the dispatch path, so its three programs are compiled — routable
+        without the background worker ever touching the rung. ``epoch``
+        is the registry epoch the caller captured BEFORE dispatching: a
+        verify racing ``device.reset_compiled_state()`` must not
+        resurrect a rung whose jit caches were just dropped."""
+        rung = (int(b), int(k), int(m))
+        impl = self._impl()
+        if self.registry.mark_ready(rung, impl, epoch=epoch):
+            # persisted=False: the compile happened inside the verify,
+            # with no before/after cache probe around it — organic warmth
+            # is in-process routing knowledge only and never writes
+            # manifest entries (the AOT walk and warmup CLI, which DO
+            # probe, own the warm-start claims)
+            self._record_ready(
+                rung, impl, seconds=None, source="organic", persisted=False
+            )
+
+    def _cache_files(self) -> Optional[set]:
+        """Executable entries currently in the cache dir (None when no
+        live manifest/cache): the before half of the probe that keeps
+        the manifest at least as conservative as the cache."""
+        if self.manifest is None or not self.cache_dir:
+            return None
+        return _cache.executable_entries(self.cache_dir)
+
+    def _record_ready(
+        self,
+        rung: Rung,
+        impl: str,
+        seconds: float | None,
+        source: str,
+        persisted: bool = True,
+    ) -> None:
+        with self._cv:  # worker thread AND organic-warmth verify threads
+            self._compiled_total += 1
+        if self.manifest is not None and persisted:
+            try:
+                env_key = _cache.environment_key(impl)
+                self.manifest.add_many(
+                    [
+                        _cache.manifest_key(env_key, stage, *rung)
+                        for stage in ("stage1", "stage2", "stage3")
+                    ],
+                    source=source,
+                )
+            except Exception:
+                pass  # manifest is an optimization, never a failure source
+        flight_recorder.record(
+            "compile_ready",
+            b=rung[0], k=rung[1], m=rung[2],
+            fp_impl=impl,
+            seconds=None if seconds is None else round(seconds, 3),
+            source=source,
+            persisted=persisted,
+        )
+
+    # -- background worker ------------------------------------------------
+
+    def _loop(self) -> None:
+        # identity check: stop() gives up joining after 10 s (a compile
+        # cannot be cancelled) and a subsequent start() spawns a fresh
+        # worker — when THIS thread is no longer self._thread it has been
+        # superseded and must exit instead of double-draining the queue
+        me = threading.current_thread()
+        while True:
+            with self._cv:
+                while (
+                    not self._queue
+                    and not self._stopped
+                    and self._thread is me
+                ):
+                    self._cv.wait()
+                if self._stopped or self._thread is not me:
+                    return
+                rung = self._queue.popleft()
+                self._queued.discard(rung)
+                self._in_flight = rung
+                _QUEUE_DEPTH.set(len(self._queue))
+            try:
+                self._compile_rung(rung)
+            finally:
+                with self._cv:
+                    # a superseding worker may already be mid-compile on
+                    # its own rung: only clear OUR marker (and the gauge —
+                    # a superseded worker's cleanup must not zero it under
+                    # the replacement's active compile)
+                    if self._in_flight == rung:
+                        self._in_flight = None
+                        _IN_FLIGHT.set(0)
+
+    def _compile_rung(self, rung: Rung) -> None:
+        impl = self._impl()
+        if self.registry.is_warm(rung, impl):
+            return  # warmed organically while queued
+        epoch = self.registry.epoch
+        b, k, m = rung
+        flight_recorder.record(
+            "compile_started", b=b, k=k, m=m, fp_impl=impl, source="aot"
+        )
+        _IN_FLIGHT.set(1)
+        files_before = self._cache_files()
+        t0 = time.perf_counter()
+        try:
+            with tracing.span(
+                "compile_service.compile", b=b, k=k, m=m, fp_impl=impl
+            ):
+                if self._compile_rung_fn is not None:
+                    stages = self._compile_rung_fn(b, k, m)
+                else:
+                    from . import lowering
+
+                    stages = lowering.warm_staged(b, k, m)
+        except Exception as e:  # a failed compile must not kill the worker
+            with self._cv:
+                self._failed_total += 1
+            # stage-attributed accounting: stages that DID compile before
+            # the failure count ok (with their durations); only the stage
+            # that raised counts error. A non-staged exception (injected
+            # compile fns, import failures) attributes all three.
+            partial = getattr(e, "partial", None) or {}
+            failed_stage = getattr(e, "stage", None)
+            for stage, rec in partial.items():
+                _COMPILES.with_labels(stage, "ok").inc()
+                _COMPILE_SECONDS.with_labels(stage).observe(
+                    float(rec.get("seconds", 0.0))
+                )
+            failed = (
+                (failed_stage,)
+                if failed_stage is not None
+                else tuple(
+                    s for s in ("stage1", "stage2", "stage3")
+                    if s not in partial
+                )
+            )
+            for stage in failed:
+                _COMPILES.with_labels(stage, "error").inc()
+            flight_recorder.record(
+                "compile_failed", b=b, k=k, m=m, fp_impl=impl,
+                error=repr(e)[:200],
+            )
+            from ..utils import logging as tlog
+
+            tlog.log(
+                "warn", "compile service rung failed",
+                b=b, k=k, m=m, fp_impl=impl, error=repr(e)[:120],
+            )
+            return
+        seconds = time.perf_counter() - t0
+        for stage, rec in (stages or {}).items():
+            _COMPILES.with_labels(stage, "ok").inc()
+            _COMPILE_SECONDS.with_labels(stage).observe(
+                float(rec.get("seconds", 0.0))
+            )
+        # manifest honesty: a FRESH compile that left no new executable
+        # in the cache dir must not add manifest entries — the manifest
+        # stays at least as conservative as the cache
+        persisted = _cache.persisted_after(
+            self.cache_dir,
+            files_before,
+            any(rec.get("fresh") for rec in (stages or {}).values()),
+        )
+        if self.registry.mark_ready(rung, impl, epoch=epoch):
+            self._record_ready(
+                rung, impl, seconds=seconds, source="aot", persisted=persisted
+            )
+
+    # -- introspection ----------------------------------------------------
+
+    def status(self) -> dict:
+        """One document for /lighthouse/health: warm surface, queue,
+        cold-route pressure and the persistent-cache state."""
+        with self._cv:
+            queue = list(self._queue)
+            in_flight = self._in_flight
+            compiled_total = self._compiled_total
+            failed_total = self._failed_total
+            cold_routes = dict(self._cold_routes)
+        prebaked = []
+        if self.manifest is not None:
+            try:
+                prebaked = self.manifest.prebaked_rungs(
+                    _cache.environment_key(self._impl())
+                )
+            except Exception:
+                prebaked = []
+        return {
+            "running": self.active(),
+            "plan": [list(r) for r in self.plan],
+            "warm_rungs": [list(r) for r in self.registry.warm_rungs()],
+            "queue": [list(r) for r in queue],
+            "in_flight": None if in_flight is None else list(in_flight),
+            "compiled_total": compiled_total,
+            "failed_total": failed_total,
+            "cold_routes": cold_routes,
+            "cache": {**self.cache_status, "prebaked_rungs": [list(r) for r in prebaked]},
+        }
+
+
+# ---------------------------------------------------------------------------
+# Process-global service (the seam bls.TpuBackend and
+# device.reset_compiled_state reach without plumbing a handle through
+# every caller; the client builder owns the lifecycle).
+# ---------------------------------------------------------------------------
+
+_service_lock = threading.Lock()
+_service: Optional[CompileService] = None
+
+
+def set_service(svc: Optional[CompileService]) -> None:
+    global _service
+    with _service_lock:
+        _service = svc
+
+
+def clear_service(svc: Optional[CompileService] = None) -> None:
+    """Detach the global service (only if it still IS ``svc`` when one
+    is given — a racing rebuild must not lose its fresh service)."""
+    global _service
+    with _service_lock:
+        if svc is None or _service is svc:
+            _service = None
+
+
+def get_service() -> Optional[CompileService]:
+    return _service
+
+
+def get_active_service() -> Optional[CompileService]:
+    svc = _service
+    if svc is not None and svc.active():
+        return svc
+    return None
+
+
+def invalidate_registry() -> None:
+    """``device.reset_compiled_state()`` hook: invalidate the global
+    service's warm-shape registry (no-op without one)."""
+    svc = _service
+    if svc is not None:
+        svc.invalidate()
+
+
+def env_enabled() -> bool:
+    return os.environ.get(_ENV_ENABLED, "1") not in ("", "0")

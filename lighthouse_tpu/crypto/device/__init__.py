@@ -30,3 +30,28 @@ def _make_tpu_backend():
 
 
 _backend.register("tpu", _make_tpu_backend)
+
+
+def reset_compiled_state() -> None:
+    """Drop EVERY compiled device program and the accounting keyed on it
+    — the one switch to flip around an ``fp.set_impl`` change (dispatch
+    is trace-time, so stale jitted kernels would otherwise survive):
+
+    * ``jax.clear_caches()`` — the jit dispatch caches;
+    * ``bls.reset_recompile_tracking()`` — the recompile counter's seen
+      signatures (the next dispatches ARE fresh compiles);
+    * the compile service's warm-shape registry (when one is attached)
+      — rungs that would now recompile must stop routing as warm, and
+      the background worker re-warms the configured plan.
+
+    Replaces the manual ``jax.clear_caches()`` +
+    ``reset_recompile_tracking()`` pairing call sites used to carry.
+    """
+    import jax
+
+    from ...compile_service import service as _csvc
+    from . import bls as _bls
+
+    jax.clear_caches()
+    _bls.reset_recompile_tracking()
+    _csvc.invalidate_registry()

@@ -406,8 +406,8 @@ _RECOMPILES = metrics.counter_vec(
     "bls_device_recompiles_total",
     "fresh (shape, dtype, fp_impl) argument signatures per staged program "
     "— each one costs an XLA compile, assuming callers follow the "
-    "fp.set_impl contract (fp.py): switch impls only with "
-    "jax.clear_caches(), paired here with reset_recompile_tracking()",
+    "fp.set_impl contract (fp.py): switch impls only through "
+    "device.reset_compiled_state()",
     ("stage",),
 )
 _LANES = metrics.counter_vec(
@@ -431,12 +431,24 @@ _seen_lock = threading.Lock()
 
 
 def reset_recompile_tracking() -> None:
-    """Forget seen argument signatures. Call alongside
-    ``jax.clear_caches()`` (the ``fp.set_impl`` workflow): XLA will
+    """Forget seen argument signatures. Callers should not pair this
+    with ``jax.clear_caches()`` by hand anymore — use
+    ``device.reset_compiled_state()`` (crypto/device/__init__.py), which
+    also invalidates the compile service's warm-shape registry: XLA will
     recompile every program, and the recompile counter should see the
     next dispatches as fresh rather than silently absorbing the cost."""
     with _seen_lock:
         _seen_stage_shapes.clear()
+
+
+def _active_compile_service():
+    """The process-global CompileService when one is attached and
+    running (compile_service/service.py) — the warm-shape router the
+    packers pad against. Lazy import: the service package is jax-free,
+    but this module must not depend on it at import time."""
+    from ...compile_service import service as _csvc
+
+    return _csvc.get_active_service()
 
 
 def _run_stage(stage: str, fn, *args):
@@ -760,35 +772,73 @@ class TpuBackend:
                 return False
         path = "raw_staged" if raw_mode else "hashed"
         impl = fp.get_impl()
+        # requested geometry, computed ONCE for warm-shape routing and
+        # the padding accounting (the packer's own dedup still runs — it
+        # needs the index mapping, not just the count)
+        k_req = max(len(pks) for _, pks, _ in sets)
+        m_req = len({bytes(m) for _, _, m in sets})
+        # warm-shape routing (compile_service): when a service is
+        # attached and a warm rung covers this batch, pad UP to it so
+        # the dispatch hits an already-compiled staged program instead
+        # of paying a fresh XLA compile on the caller's thread
+        pad_b = pad_k = pad_m = None
+        svc = _active_compile_service() if raw_mode else None
+        warm_epoch = None
+        if svc is not None:
+            # epoch BEFORE dispatch: if reset_compiled_state() lands while
+            # we verify, the organic mark below must be rejected as stale
+            warm_epoch = svc.registry.epoch
+            rung = svc.pads_for(len(sets), k_req, m_req)
+            if rung is not None:
+                pad_b, pad_k, pad_m = rung
         with tracing.span(
             "bls.verify_signature_sets", path=path, n_sets=len(sets)
         ) as sp, _VERIFY_SECONDS.with_labels(path, impl).time():
             with tracing.span("bls.pack"), _PACK_SECONDS.time():
                 if raw_mode:
-                    args = pack_signature_sets_raw(sets)
+                    args = pack_signature_sets_raw(
+                        sets, pad_b=pad_b, pad_k=pad_k, pad_m=pad_m
+                    )
                 else:
                     args = pack_signature_sets_hashed(sets)
-            self._record_geometry(sets, args)
+            self._record_geometry(sets, args, k_req=k_req, m_req=m_req)
             if raw_mode:
                 out = bool(verify_batch_raw_staged(*args))
             else:
                 out = bool(verify_batch_hashed(*args))
             sp.set(verdict=out)
+        if raw_mode and svc is not None:
+            # organic warmth: whatever rung this batch landed on is
+            # compiled now (whatever the verdict) — routable without the
+            # AOT worker. OUTSIDE the timed window: the first mark per
+            # rung writes the manifest to disk.
+            svc.note_rung_verified(
+                int(args[0].shape[0]),    # B (pk_xy)
+                int(args[0].shape[1]),    # K
+                int(args[4].shape[0]),    # M (msg_u)
+                epoch=warm_epoch,
+            )
         _OUTCOMES.with_labels("ok" if out else "fail").inc()
         return out
 
     @staticmethod
-    def _record_geometry(sets, packed_args) -> None:
+    def _record_geometry(
+        sets, packed_args, k_req: int | None = None, m_req: int | None = None
+    ) -> None:
         """Batch-geometry accounting: requested vs padded B/K/M lanes and
         the padding-waste fraction of the pubkey plane (the device pays
-        for padded lanes; the caller only needed the requested ones)."""
+        for padded lanes; the caller only needed the requested ones).
+        ``k_req``/``m_req`` take the caller's already-computed request
+        geometry so the message set is not hashed twice per batch."""
         pk_xy = packed_args[0]
         b_pad, k_pad = int(pk_xy.shape[0]), int(pk_xy.shape[1])
         # raw/hashed packers put msg_u [M, 2, 2, NL] at index 4/3
         m_pad = int(packed_args[4 if len(packed_args) == 8 else 3].shape[0])
         b_req = len(sets)
-        k_req = max(len(pks) for _, pks, _ in sets)
-        m_req = len({bytes(m) for _, _, m in sets})
+        if k_req is None:
+            k_req = max(len(pks) for _, pks, _ in sets)
+        if m_req is None:
+            m_req = len({bytes(m) for _, _, m in sets})
         for dim, req, pad in (
             ("b", b_req, b_pad), ("k", k_req, k_pad), ("m", m_req, m_pad)
         ):

@@ -44,8 +44,10 @@ Multiplication exists in selectable implementations (``FP_IMPL``):
 
 Select with ``LIGHTHOUSE_TPU_FP_IMPL`` (env, like the BLS backend flag in
 ``crypto/backend.py``) or :func:`set_impl` / the :func:`impl` context
-manager. NOTE: callers that hold jitted programs must ``jax.clear_caches()``
-after switching — dispatch happens at trace time.
+manager. NOTE: callers that hold jitted programs must call
+``device.reset_compiled_state()`` (crypto/device/__init__.py) after
+switching — dispatch happens at trace time, and that helper also resets
+recompile tracking and the compile service's warm-shape registry.
 """
 
 from __future__ import annotations
@@ -368,7 +370,8 @@ def get_impl() -> str:
 def set_impl(name: str) -> None:
     """Select the fp.mul implementation. Dispatch happens at TRACE time:
     callers holding jitted programs (e.g. device/bls.py's staged pipeline)
-    must ``jax.clear_caches()`` afterwards or they keep the old kernels."""
+    must call ``device.reset_compiled_state()`` afterwards or they keep
+    the old kernels (and stale warm-shape routing)."""
     global _active_impl
     if name not in _MUL_IMPLS:
         raise KeyError(f"unknown fp impl {name!r}; have {sorted(_MUL_IMPLS)}")

@@ -416,6 +416,10 @@ class BeaconApiServer:
             doc["verification_scheduler"] = (
                 None if sched is None else sched.status()
             )
+            # AOT compile service: warm-shape surface, compile queue and
+            # persistent-cache state (null when the node runs without one)
+            csvc = getattr(chain, "compile_service", None)
+            doc["compile_service"] = None if csvc is None else csvc.status()
             return {"data": doc}
         if path == "/lighthouse/flight_recorder":
             # live journal tail: ?kind=a,b filters, ?limit=N bounds the

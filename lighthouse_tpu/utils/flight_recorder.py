@@ -58,6 +58,10 @@ EVENT_KINDS = (
     "attestation_rejected",   # beacon_chain/attestation_verification.py
     "block_rejected",         # beacon_chain/block_verification.py
     "bls_stage_verify",       # crypto/device/bls.py, one per staged verify
+    "cold_route",             # compile_service/service.py, cold-bucket flush
+    "compile_failed",         # compile_service/service.py, per failed rung
+    "compile_ready",          # compile_service/service.py, rung now warm
+    "compile_started",        # compile_service/service.py, per AOT rung
     "log",                    # utils/logging.py, warn/error/crit lines
     "peer_ban",               # network/peer_manager.py
     "peer_penalty",           # network/peer_manager.py

@@ -44,6 +44,17 @@ without bound.
 
 Latency-critical callers (block verification) use :meth:`verify_now`,
 a counted synchronous bypass that never waits on a deadline.
+
+Cold-bucket protection (ISSUE 5): with a
+:class:`~lighthouse_tpu.compile_service.CompileService` attached, every
+flush (and every ``verify_now`` bypass) is routed first — a batch whose
+padded bucket has no compiled staged program is served through the
+service's counted synchronous CPU-native fallback (identical verdict,
+``cold_route`` journal event) instead of blocking a gossip-hot thread
+on a multi-minute XLA compile; the service compiles the rung in the
+background and subsequent flushes run on device. Without a service
+attached (the default, and every pre-existing test) behavior is
+byte-identical to before.
 """
 
 from __future__ import annotations
@@ -168,8 +179,12 @@ class VerificationScheduler:
         deadline_ms: float | None = None,
         max_batch_sets: int | None = None,
         max_queue_sets: int | None = None,
+        compile_service=None,
     ):
         self._verify = verify_fn or bls.verify_signature_sets
+        # warm-shape router (compile_service/service.py); None = every
+        # flush dispatches directly, cold compiles and all
+        self._compile_service = compile_service
         self.deadline_s = (
             deadline_ms
             if deadline_ms is not None
@@ -281,8 +296,18 @@ class VerificationScheduler:
             ):
                 # leaf resolution in the caller's thread: verdict, outcome
                 # accounting and exception delivery all match the direct
-                # call this submission degraded to
-                self._resolve_group([sub])
+                # call this submission degraded to. Cold-rung protection
+                # applies HERE too — a backpressure shed must not block a
+                # gossip caller on an XLA compile either.
+                verify = None
+                svc = self._compile_service
+                if svc is not None and svc.active():
+                    decision = svc.decide_flush(
+                        sub.sets, caller=f"shed:{kind}"
+                    )
+                    if decision["action"] == "shed":
+                        verify = svc.fallback_verify
+                self._resolve_group([sub], verify)
         return sub.future
 
     def verify_now(self, sets, kind: str = "block") -> bool:
@@ -292,6 +317,14 @@ class VerificationScheduler:
         sets = list(sets)
         _BYPASS.with_labels(kind).inc()
         with tracing.span("scheduler.bypass", kind=kind, n_sets=len(sets)):
+            svc = self._compile_service
+            if svc is not None and svc.active():
+                # even the latency-critical bypass must not stall on a
+                # cold-bucket XLA compile: shed to the service's counted
+                # synchronous fallback (identical verdict)
+                decision = svc.decide_flush(sets, caller=f"verify_now:{kind}")
+                if decision["action"] == "shed":
+                    return svc.fallback_verify(sets)
             return self._verify(sets)
 
     def flush(self) -> None:
@@ -369,14 +402,28 @@ class VerificationScheduler:
         self._buckets_seen.add(bucket)
         self._last_occupancy = occupancy
         bisections_before = self._bisections
+        # cold-bucket routing: a flush whose padded rung has no compiled
+        # staged program is served through the compile service's counted
+        # synchronous fallback (and bisects there too — verdict identity
+        # holds leaf by leaf) while the rung compiles in the background
+        verify = self._verify
+        route_action = "direct"
+        fused = [st for s in subs for st in s.sets]  # flattened ONCE
+        svc = self._compile_service
+        if svc is not None and svc.active():
+            decision = svc.decide_flush(fused, caller=f"flush:{trigger}")
+            route_action = decision["action"]
+            if route_action == "shed":
+                verify = svc.fallback_verify
         with tracing.span(
             "scheduler.flush",
             trigger=trigger,
             kinds=kinds_mix,
             n_submissions=len(subs),
             n_sets=n_sets,
+            route=route_action,
         ) as sp:
-            all_ok = self._resolve_group(subs)
+            all_ok = self._resolve_group(subs, verify, fused=fused)
             sp.set(verdict=all_ok)
         flight_recorder.record(
             "scheduler_flush",
@@ -392,14 +439,25 @@ class VerificationScheduler:
 
     # -- verdict resolution (split-and-retry isolation) -------------------
 
-    def _resolve_group(self, subs: List[_Submission]) -> bool:
+    def _resolve_group(
+        self, subs: List[_Submission], verify: Optional[Callable] = None,
+        fused: Optional[list] = None,
+    ) -> bool:
         """Verify ``subs`` as one fused call; on False — or on a raised
         backend exception, which a larger fused shape can hit even when
         each member's own call would not — bisect so every submission
         ends at exactly the verdict (or exception) its own direct call
-        produces. Only a LEAF failure is delivered to a future."""
+        produces. Only a LEAF failure is delivered to a future.
+        ``verify`` overrides the backend for the WHOLE resolution tree
+        (the compile service's shed fallback); ``fused`` is the caller's
+        already-flattened set list (bisection sub-calls re-flatten)."""
+        if verify is None:
+            verify = self._verify
         try:
-            ok = bool(self._verify([st for s in subs for st in s.sets]))
+            ok = bool(verify(
+                fused if fused is not None
+                else [st for s in subs for st in s.sets]
+            ))
         except BaseException as e:  # noqa: BLE001 — flush thread survives
             if len(subs) == 1:
                 sub = subs[0]
@@ -409,7 +467,7 @@ class VerificationScheduler:
                 if not sub.future.done():
                     sub.future.set_exception(e)
                 return False
-            return self._bisect(subs)
+            return self._bisect(subs, verify)
         if ok:
             for s in subs:
                 self._finish(s, True)
@@ -418,9 +476,11 @@ class VerificationScheduler:
             # leaf: this fused call WAS the direct per-caller call
             self._finish(subs[0], False)
             return False
-        return self._bisect(subs)
+        return self._bisect(subs, verify)
 
-    def _bisect(self, subs: List[_Submission]) -> bool:
+    def _bisect(
+        self, subs: List[_Submission], verify: Optional[Callable] = None
+    ) -> bool:
         self._bisections += 1
         _BISECTIONS.inc()
         flight_recorder.record(
@@ -430,8 +490,8 @@ class VerificationScheduler:
             kinds="+".join(sorted({s.kind for s in subs})),
         )
         mid = len(subs) // 2
-        left = self._resolve_group(subs[:mid])
-        right = self._resolve_group(subs[mid:])
+        left = self._resolve_group(subs[:mid], verify)
+        right = self._resolve_group(subs[mid:], verify)
         return left and right
 
     def _finish(self, sub: _Submission, ok: bool) -> None:
@@ -460,6 +520,7 @@ class VerificationScheduler:
             "shed_total": self._shed,
             "last_batch_occupancy": round(self._last_occupancy, 4),
             "buckets_seen": sorted(self._buckets_seen),
+            "compile_service_attached": self._compile_service is not None,
         }
 
 

@@ -128,9 +128,8 @@ def test_staged_verify_b64_matmul_int8(rng, tmp_path):
     journal a ``bls_stage_verify`` event and dump a forensics artifact
     that ``tools/forensics_report.py`` renders with per-stage latency
     attribution."""
-    import jax
-
     import tools.forensics_report as forensics
+    from lighthouse_tpu.crypto import device
     from lighthouse_tpu.crypto.device import fp as device_fp
     from lighthouse_tpu.utils import flight_recorder as fr
 
@@ -157,8 +156,7 @@ def test_staged_verify_b64_matmul_int8(rng, tmp_path):
         min_dump_interval_s=0.0,
     )
     with device_fp.impl(device_fp.IMPL_MATMUL_INT8):
-        jax.clear_caches()
-        device_bls.reset_recompile_tracking()
+        device.reset_compiled_state()
         try:
             ok = device_bls.verify_batch_raw_staged(
                 *device_bls.pack_signature_sets_raw(
@@ -171,8 +169,7 @@ def test_staged_verify_b64_matmul_int8(rng, tmp_path):
                 )
             )
         finally:
-            jax.clear_caches()  # never leak int8-traced kernels to others
-            device_bls.reset_recompile_tracking()
+            device.reset_compiled_state()  # never leak int8-traced kernels
             fr.configure(**prev)
     assert bool(ok) is True
     assert bool(bad) is False

@@ -32,6 +32,7 @@ KNOWN_PREFIXES = (
     "beacon_processor_",
     "block_",
     "bls_device_",
+    "compile_service_",
     "flight_recorder_",
     "head_",
     "http_api_",
@@ -59,6 +60,7 @@ def _import_instrumented_modules():
     import lighthouse_tpu.beacon_chain.block_verification  # noqa: F401
     import lighthouse_tpu.beacon_chain.validator_monitor  # noqa: F401
     import lighthouse_tpu.beacon_processor.processor  # noqa: F401
+    import lighthouse_tpu.compile_service.service  # noqa: F401
     import lighthouse_tpu.crypto.device.bls  # noqa: F401
     import lighthouse_tpu.http_api.server  # noqa: F401
     import lighthouse_tpu.utils.flight_recorder  # noqa: F401
@@ -169,6 +171,52 @@ def test_verification_scheduler_families_registered():
             assert m.labelnames == labels, (name, m.labelnames)
         else:
             assert not hasattr(m, "labelnames"), name  # unlabeled family
+
+
+def test_compile_service_families_registered():
+    """ISSUE 5 families (compile_service/service.py) exist under their
+    declared types + labels."""
+    _import_instrumented_modules()
+    reg = metrics.registry_snapshot()
+    want = {
+        "compile_service_compiles_in_flight": ("gauge", None),
+        "compile_service_warm_rungs": ("gauge", None),
+        "compile_service_queue_depth": ("gauge", None),
+        "compile_service_compiles_total": ("counter", ("stage", "outcome")),
+        "compile_service_compile_seconds": ("histogram", ("stage",)),
+        "compile_service_cold_routes_total": ("counter", ("action",)),
+    }
+    for name, (kind, labels) in want.items():
+        m = reg.get(name)
+        assert m is not None, f"family {name} not registered"
+        assert m.kind == kind, (name, m.kind)
+        if labels is not None:
+            assert m.labelnames == labels, (name, m.labelnames)
+        else:
+            assert not hasattr(m, "labelnames"), name  # unlabeled family
+
+
+def test_warmup_tool_imports_and_dry_run_lists_ladder(capsys, monkeypatch):
+    """ISSUE 5 CI satellite: ``tools/warmup.py`` must import cleanly and
+    ``--dry-run`` must list the ladder walk WITHOUT compiling anything
+    (the compile path is boobytrapped here to prove it stays untouched)."""
+    import tools.warmup as warmup
+    from lighthouse_tpu.compile_service import DEFAULT_RUNGS, lowering
+
+    def boom(*a, **k):  # pragma: no cover — reaching this is the failure
+        raise AssertionError("--dry-run must not compile")
+
+    monkeypatch.setattr(lowering, "warm_staged", boom)
+    monkeypatch.setattr(lowering, "timed_lower_compile", boom)
+    # the operator knob must not leak into the DEFAULT_RUNGS assertion
+    monkeypatch.delenv("LIGHTHOUSE_TPU_COMPILE_RUNGS", raising=False)
+    assert warmup.main(["--dry-run"]) == 0
+    out = capsys.readouterr().out
+    for b, k, m in DEFAULT_RUNGS:
+        assert f"B={b} K={k} M={m}" in out, out
+    # an explicit plan overrides the default and is echoed verbatim
+    assert warmup.main(["--dry-run", "--rungs", "4:1:1"]) == 0
+    assert "B=4 K=1 M=1" in capsys.readouterr().out
 
 
 def test_journal_event_kinds_snake_case_and_documented():

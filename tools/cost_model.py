@@ -256,6 +256,16 @@ def main() -> None:
       "([VERIFICATION_SERVICE.md](VERIFICATION_SERVICE.md); occupancy and "
       "padding-waste gauges in "
       "[OBSERVABILITY.md](OBSERVABILITY.md)).")
+    w("- Setup cost, not in these tables: the FIRST dispatch of each "
+      "staged program at a fresh bucket shape pays the XLA compile "
+      "(~120 s for the B=64 headline rung on this host, BENCH_r05 / the "
+      "bench `startup` block). The compile service moves that cost off "
+      "the hot path — AOT ladder warmup, pad-up routing to warm rungs, "
+      "counted CPU fallback while a cold rung compiles, and a persistent "
+      "executable cache so a restarted node pays it from disk "
+      "([COMPILE_SERVICE.md](COMPILE_SERVICE.md); "
+      "`compile_service_compile_seconds` per-stage histogram in "
+      "[OBSERVABILITY.md](OBSERVABILITY.md)).")
     w("")
     out = REPO / "docs" / "COST_MODEL.md"
     out.write_text("\n".join(lines) + "\n")

@@ -4,78 +4,33 @@ Compile time is a tracked metric (VERDICT r5 rec #3: 120.7 s warm-up in
 BENCH_r05 at the SHRUNK fallback shapes); XLA's cost tracks emitted
 program size, so the shape-stable proxy pinned here is the
 pre-optimization StableHLO instruction count of each staged program.
-Shared by ``tools/profile_compile2.py`` (measurement) and
-``tests/test_zgate2_compile_budget.py`` (regression gate).
+
+As of ISSUE 5 the actual shape-building and lowering live in
+``lighthouse_tpu/compile_service/lowering.py`` — ONE definition shared
+by this gate (``tests/test_zgate2_compile_budget.py``), the compile
+profilers (``tools/profile_compile*.py``) and the CompileService's AOT
+warmup, so the programs the budgets measure are provably the programs
+the service compiles and the node dispatches. This module stays as the
+tools-facing spelling.
 """
 
 from __future__ import annotations
 
-import time
+import os
+import sys
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-def hlo_instruction_count(lowered_or_text) -> int:
-    """SSA assignments in a lowered program's StableHLO text. Accepts the
-    lowered object or its pre-rendered ``as_text()`` string (rendering a
-    100k-line program is itself expensive — callers that also need line
-    counts should render once and pass the text)."""
-    try:
-        text = (
-            lowered_or_text
-            if isinstance(lowered_or_text, str)
-            else lowered_or_text.as_text()
-        )
-        return sum(1 for ln in text.splitlines() if " = " in ln)
-    except Exception:
-        return -1
+from lighthouse_tpu.compile_service.lowering import (  # noqa: E402,F401
+    hlo_instruction_count,
+    staged_instruction_counts,
+    staged_programs,
+    timed_lower_compile,
+)
 
-
-def staged_instruction_counts(B: int, K: int, M: int) -> dict:
-    """Lower (no compile) the three staged programs of
-    ``crypto/device/bls.py`` at bucket shape (B, K, M) and return
-    ``{stage: {instructions, lower_s}}``."""
-    import jax
-    import jax.numpy as jnp
-
-    from lighthouse_tpu.crypto.device import bls as dbls
-    from lighthouse_tpu.crypto.device import fp
-
-    f2 = jnp.zeros((B, 2, fp.NL), jnp.int32)
-    shapes = {
-        "stage1": (
-            dbls._stage1_fn,
-            (f2, jnp.zeros((B,), bool), jnp.zeros((M, 2, 2, fp.NL), jnp.int32)),
-        ),
-        "stage2": (
-            dbls._stage2_fn,
-            (
-                jnp.zeros((B, K, 2, fp.NL), jnp.int32),
-                jnp.zeros((B, K), bool),
-                jnp.zeros((B, 2, 2, fp.NL), jnp.int32),
-                jnp.zeros((B, 2), jnp.int32),
-                jnp.zeros((B,), bool),
-            ),
-        ),
-        "stage3": (
-            dbls._stage3_fn,
-            (
-                jnp.zeros((B, fp.NL), jnp.int32),
-                jnp.zeros((B, fp.NL), jnp.int32),
-                jnp.zeros((B,), bool),
-                jnp.zeros((B, 2, fp.NL), jnp.int32),
-                jnp.zeros((B, 2, fp.NL), jnp.int32),
-                jnp.zeros((B,), bool),
-                jnp.zeros((2, fp.NL), jnp.int32),
-                jnp.zeros((2, fp.NL), jnp.int32),
-                jnp.zeros((), bool),
-            ),
-        ),
-    }
-    out = {}
-    for name, (fn, args) in shapes.items():
-        t0 = time.perf_counter()
-        lowered = jax.jit(fn).lower(*args)
-        out[name] = {
-            "instructions": hlo_instruction_count(lowered),
-            "lower_s": round(time.perf_counter() - t0, 2),
-        }
-    return out
+__all__ = [
+    "hlo_instruction_count",
+    "staged_instruction_counts",
+    "staged_programs",
+    "timed_lower_compile",
+]

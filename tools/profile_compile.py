@@ -7,7 +7,6 @@ Usage: JAX_PLATFORMS=cpu python tools/profile_compile.py [B] [K] [M]
 
 import os
 import sys
-import time
 
 # FORCE the CPU platform — the image presets JAX_PLATFORMS=axon (the real
 # TPU tunnel); a dead relay makes any axon initialization hang forever.
@@ -20,6 +19,7 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 import jax.numpy as jnp
 
+from lighthouse_tpu.compile_service.lowering import timed_lower_compile
 from lighthouse_tpu.crypto.device import bls as dbls
 from lighthouse_tpu.crypto.device import curve, fp, fp2, htc, pairing, tower
 
@@ -29,21 +29,15 @@ M = int(sys.argv[3]) if len(sys.argv) > 3 else 4
 
 
 def clock(name, fn, *args):
-    t0 = time.perf_counter()
-    lowered = jax.jit(fn).lower(*args)
-    t1 = time.perf_counter()
-    try:
-        n_lines = len(lowered.as_text().splitlines())
-    except Exception:
-        n_lines = -1
-    compiled = lowered.compile()
-    t2 = time.perf_counter()
+    # one shared lower+compile clock (compile_service/lowering.py) so
+    # this profile times exactly what the compile service compiles
+    rec = timed_lower_compile(fn, args)
     print(
-        f"{name:32s} lower {t1-t0:7.2f}s  compile {t2-t1:7.2f}s  "
-        f"hlo_lines {n_lines}",
+        f"{name:32s} lower {rec['lower_s']:7.2f}s  "
+        f"compile {rec['compile_s']:7.2f}s  hlo_lines {rec['hlo_lines']}",
         flush=True,
     )
-    return compiled
+    return rec
 
 
 g1 = jnp.zeros((B, 2, fp.NL), jnp.int32)

@@ -6,7 +6,6 @@ Usage: python tools/profile_compile2.py [B]
 
 import os
 import sys
-import time
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 
@@ -17,33 +16,23 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 import jax.numpy as jnp
 
-from lighthouse_tpu.crypto.device import bls as dbls
+from lighthouse_tpu.compile_service.lowering import (  # noqa: E402
+    staged_instruction_counts,
+    timed_lower_compile,
+)
 from lighthouse_tpu.crypto.device import curve, fp, fp2, htc, pairing, tower
 
 B = int(sys.argv[1]) if len(sys.argv) > 1 else 16
 
 
-from tools.hlo_stats import (  # noqa: E402
-    hlo_instruction_count,
-    staged_instruction_counts,
-)
-
-
 def clock(name, fn, *args):
-    t0 = time.perf_counter()
-    lowered = jax.jit(fn).lower(*args)
-    t1 = time.perf_counter()
-    try:
-        text = lowered.as_text()  # rendered ONCE; both stats come from it
-        n_lines = len(text.splitlines())
-        n_instr = hlo_instruction_count(text)
-    except Exception:
-        n_lines = n_instr = -1
-    lowered.compile()
-    t2 = time.perf_counter()
+    # shared lower+compile clock (compile_service/lowering.py): this
+    # profile and the compile service exercise the same code path
+    rec = timed_lower_compile(fn, args)
     print(
-        f"{name:28s} lower {t1-t0:7.2f}s  compile {t2-t1:7.2f}s  "
-        f"hlo_lines {n_lines}  hlo_instr {n_instr}",
+        f"{name:28s} lower {rec['lower_s']:7.2f}s  "
+        f"compile {rec['compile_s']:7.2f}s  "
+        f"hlo_lines {rec['hlo_lines']}  hlo_instr {rec['hlo_instr']}",
         flush=True,
     )
 

@@ -1,0 +1,188 @@
+"""Prebake the staged device BLS programs into the persistent compile
+cache (ISSUE 5): run the CompileService's ladder walk synchronously so a
+node (or bench) started afterwards with the same cache dir warm-starts
+with zero fresh XLA staged compiles.
+
+    # list the walk without importing jax or compiling anything
+    python tools/warmup.py --dry-run
+
+    # bake the default ladder under the active engine
+    LIGHTHOUSE_TPU_COMPILE_CACHE_DIR=/var/cache/lighthouse \\
+        python tools/warmup.py
+
+    # bake specific rungs into an explicit dir, one JSON line at the end
+    python tools/warmup.py --cache-dir /tmp/cache --rungs 4:1:1,64:16:8 --json
+
+The platform is whatever JAX resolves (set ``JAX_PLATFORMS=cpu`` to bake
+an XLA:CPU cache, e.g. the bench fallback). Each rung compiles the three
+staged programs through the same ``lowering.warm_staged`` path the
+in-node service uses, so the executables, the manifest entries and the
+recompile accounting all match what the node will look for.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _parse_rungs(raw: str):
+    rungs = []
+    for chunk in raw.split(","):
+        chunk = chunk.strip()
+        if not chunk:
+            continue
+        parts = chunk.split(":")
+        if len(parts) != 3:
+            raise SystemExit(f"malformed rung {chunk!r}; expected B:K:M")
+        rungs.append(tuple(int(p) for p in parts))
+    if not rungs:
+        raise SystemExit("--rungs parsed to an empty plan")
+    return tuple(rungs)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--cache-dir",
+        default=None,
+        help="persistent cache directory (default: "
+        "LIGHTHOUSE_TPU_COMPILE_CACHE_DIR; omit both to warm jit caches "
+        "for this process only, persisting nothing)",
+    )
+    ap.add_argument(
+        "--rungs",
+        default=None,
+        help="comma list of B:K:M bucket rungs (default: the service's "
+        "ladder plan, LIGHTHOUSE_TPU_COMPILE_RUNGS-overridable)",
+    )
+    ap.add_argument(
+        "--dry-run",
+        action="store_true",
+        help="print the ladder walk in priority order and exit — no jax "
+        "import, no compiles",
+    )
+    ap.add_argument(
+        "--json", action="store_true", help="print one summary JSON line"
+    )
+    args = ap.parse_args(argv)
+
+    # plan construction is deliberately jax-free (service.py imports no
+    # jax at module level) so --dry-run stays instant on any host
+    from lighthouse_tpu.compile_service import service as csvc_mod
+    from lighthouse_tpu.compile_service import cache as cache_mod
+
+    rungs = (
+        _parse_rungs(args.rungs)
+        if args.rungs
+        else (csvc_mod._env_rungs() or csvc_mod.DEFAULT_RUNGS)
+    )
+    cache_dir = cache_mod.resolve_cache_dir(args.cache_dir)
+
+    if args.dry_run:
+        print(f"ladder walk ({len(rungs)} rungs, priority order):")
+        for i, (b, k, m) in enumerate(rungs):
+            print(f"  {i + 1}. B={b} K={k} M={m}")
+        print(f"cache_dir: {cache_dir or '(none — nothing would persist)'}")
+        return 0
+
+    cache_status = {"enabled": False, "dir": cache_dir, "reason": "unconfigured"}
+    manifest = None
+    if cache_dir:
+        # min_compile_time 0 matches the in-node service: jax's default
+        # 1 s floor would skip persisting small rungs while their
+        # manifest entries still claimed a warm start
+        cache_status = cache_mod.enable_persistent_cache(
+            cache_dir, min_compile_time_s=0.0
+        )
+        if cache_status["enabled"]:
+            manifest = cache_mod.Manifest(cache_dir)
+        else:
+            # no manifest over a dead cache: a prebaked claim with no
+            # executables behind it would falsify warm-start reporting
+            print(
+                f"persistent cache UNAVAILABLE ({cache_status['reason']}); "
+                f"warming this process only",
+                file=sys.stderr,
+            )
+
+    from lighthouse_tpu.compile_service import lowering
+    from lighthouse_tpu.crypto.device import fp
+
+    impl = fp.get_impl()
+    env_key = cache_mod.environment_key(impl)
+    records = []
+    t_total = time.perf_counter()
+    for b, k, m in rungs:
+        prebaked = bool(
+            manifest is not None
+            and all(
+                manifest.has(cache_mod.manifest_key(env_key, s, b, k, m))
+                for s in lowering.STAGES
+            )
+        )
+        files_before = (
+            cache_mod.executable_entries(cache_dir)
+            if manifest is not None
+            else None
+        )
+        t0 = time.perf_counter()
+        stages = lowering.warm_staged(b, k, m)
+        seconds = time.perf_counter() - t0
+        if manifest is not None:
+            # manifest honesty (same probe as CompileService._compile_rung):
+            # a fresh compile that left no new executable behind must not
+            # claim the rung prebaked — unless it already was (a cache-
+            # served warm restart adds no files)
+            persisted = cache_mod.persisted_after(
+                cache_dir,
+                files_before,
+                any(r["fresh"] for r in stages.values()),
+            )
+            if persisted or prebaked:
+                manifest.add_many(
+                    [
+                        cache_mod.manifest_key(env_key, stage, b, k, m)
+                        for stage in lowering.STAGES
+                    ],
+                    source="warmup_cli",
+                )
+            else:
+                print(
+                    f"cache stored no executable for B={b} K={k} M={m}; "
+                    f"manifest NOT updated",
+                    file=sys.stderr,
+                )
+        rec = {
+            "b": b, "k": k, "m": m, "fp_impl": impl,
+            "seconds": round(seconds, 2),
+            "manifest_prebaked": prebaked,
+            "stages": {
+                s: {"seconds": round(r["seconds"], 2), "fresh": r["fresh"]}
+                for s, r in stages.items()
+            },
+        }
+        records.append(rec)
+        print(
+            f"warmed B={b} K={k} M={m} [{impl}] in {seconds:7.2f}s"
+            f"{' (manifest: prebaked)' if prebaked else ''}",
+            flush=True,
+        )
+    summary = {
+        "fp_impl": impl,
+        "total_s": round(time.perf_counter() - t_total, 2),
+        "cache": cache_status,
+        "rungs": records,
+    }
+    if args.json:
+        print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
