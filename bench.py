@@ -271,6 +271,137 @@ def measure_scheduler_leg(sets, B, K, M, n_callers: int = 4, reps: int = 3):
     }
 
 
+def measure_planner_leg(sets, B, K, M, reps: int = 3):
+    """Planned multi-rung flush vs legacy single-rung flush at the
+    headline shape (ISSUE 6), same warm cache. The LEGACY leg is the
+    pre-planner behavior: the whole fused mix padded onto the one
+    headline rung (B, K, M) — already compiled by the headline bucket,
+    so it pays zero new XLA work. The PLANNED leg routes the same
+    traffic through the scheduler's shape-aware planner: kind-
+    homogeneous sub-batches (single-pubkey gossip sets on a K=1 rung,
+    committee sets on a small-B rung) whose compiles are paid once in
+    the warm-up flush; the measured reps then run at steady state —
+    recompile delta recorded to prove it stays 0. Per-leg sets/s and
+    padding_waste (the shared B*K*M lane formula) land in the JSON."""
+    import threading
+
+    import jax
+
+    from lighthouse_tpu.crypto.device import bls as device_bls
+    from lighthouse_tpu.utils import metrics
+    from lighthouse_tpu.verification_service import (
+        VerificationScheduler,
+        live_lanes,
+        padded_lanes,
+        padding_waste_ratio,
+    )
+
+    singles = [s for s in sets if len(s[1]) == 1]
+    committees = [s for s in sets if len(s[1]) > 1]
+    if not singles or not committees:
+        return {"skipped": "workload has no kind mix to split"}
+    n = len(sets)
+    live = live_lanes(
+        sum(len(pks) for _, pks, _ in sets),
+        len({bytes(m) for _, _, m in sets}),
+    )
+    # kind-faithful submissions: the two single-pubkey sets per
+    # aggregate are unaggregated-style, the committee set aggregate-style
+    subs = [("unaggregated", singles), ("aggregate", committees)]
+
+    def legacy_verify(s):
+        # pad-everything-to-the-headline-rung: the pre-planner flush
+        args = device_bls.pack_signature_sets_raw(
+            s, pad_b=B, pad_k=K, pad_m=M
+        )
+        return bool(
+            jax.block_until_ready(device_bls.verify_batch_raw_staged(*args))
+        )
+
+    def _recompiles() -> float:
+        m = metrics.get("bls_device_recompiles_total")
+        return sum(c.value for c in m.children().values()) if m else 0.0
+
+    def _gauge(name) -> float:
+        m = metrics.get(name)
+        return float(m.value) if m is not None else float("nan")
+
+    def run_flush(sched) -> float:
+        futs = [None] * len(subs)
+
+        def feed(i):
+            futs[i] = sched.submit(subs[i][1], subs[i][0])
+
+        threads = [
+            threading.Thread(target=feed, args=(i,))
+            for i in range(len(subs))
+        ]
+        t0 = time.perf_counter()
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert all(f.result(timeout=1800) for f in futs)
+        return time.perf_counter() - t0
+
+    def measure(verify_fn, plan_on):
+        sched = VerificationScheduler(
+            verify_fn=verify_fn, deadline_ms=10_000.0,
+            max_batch_sets=n,  # bucket-full fires on the last feeder
+            max_queue_sets=4 * n, plan_flushes=plan_on,
+        ).start()
+        try:
+            run_flush(sched)  # warm-up: the planned leg's compiles land here
+            rec_before = _recompiles()
+            samples = [run_flush(sched) for _ in range(reps)]
+            steady_recompiles = _recompiles() - rec_before
+            st = sched.status()
+        finally:
+            sched.stop()
+        med, spread = _median_spread(samples)
+        return {
+            "sets_per_sec": round(n / med, 2),
+            "rep_spread": round(spread, 3),
+            "steady_recompiles": steady_recompiles,
+            "plan": st["planner"]["last_plan"],
+            "scheduler_waste_gauge": round(
+                _gauge("verification_scheduler_padding_waste_ratio"), 4
+            ),
+            "device_waste_gauge": round(
+                _gauge("bls_device_padding_waste_ratio"), 4
+            ),
+        }
+
+    legacy = measure(legacy_verify, plan_on=False)
+    # the legacy verify bypasses TpuBackend and pads to the HEADLINE
+    # rung (B, K, M), not the scheduler plan's exact rung, so neither
+    # gauge describes what it actually dispatched — report its waste
+    # from the same shared formula at the rung it really padded to and
+    # drop the gauge readings rather than ship contradictory numbers
+    legacy["padding_waste"] = round(
+        padding_waste_ratio(live, padded_lanes(B, K, M)), 4
+    )
+    legacy["rung"] = [B, K, M]
+    del legacy["device_waste_gauge"]  # not touched by the direct packer
+    del legacy["scheduler_waste_gauge"]  # reflects the plan, not the pad
+    del legacy["plan"]  # ditto: the plan's exact rung was never dispatched
+
+    planned = measure(
+        device_bls.TpuBackend().verify_signature_sets, plan_on=True
+    )
+    planned["padding_waste"] = planned["plan"]["padding_waste"]
+
+    return {
+        "n_sets": n,
+        "reps": reps,
+        "legacy": legacy,
+        "planned": planned,
+        "planned_vs_legacy": round(
+            planned["sets_per_sec"] / legacy["sets_per_sec"], 4
+        ) if legacy["sets_per_sec"] else None,
+    }
+
+
 def measure_startup_leg(use_cpu: bool, probe_rung: str = "4:1:1") -> dict:
     """Cold-vs-warm node startup (ISSUE 5): the 120.7 s warmup problem
     (BENCH_r05) measured as a trajectory metric. Two ``tools/warmup.py``
@@ -454,6 +585,18 @@ def main() -> None:
         except Exception as e:  # the leg must not kill the line
             scheduler_leg = {"error": str(e)[:200]}
 
+    # Planned multi-rung flush vs legacy single-rung flush (ISSUE 6):
+    # the padding-waste fix measured at the headline mix. The planned
+    # leg pays its sub-batch rung compiles inside its warm-up flush, so
+    # it needs real budget; skipped-with-marker beats silent truncation.
+    if _budget_left() < 900:
+        planner_leg = {"skipped": "budget"}
+    else:
+        try:
+            planner_leg = measure_planner_leg(sets, B_PAD, K_PAD, M_PAD)
+        except Exception as e:  # the leg must not kill the line
+            planner_leg = {"error": str(e)[:200]}
+
     # Cold-vs-warm startup (ISSUE 5): two warmup subprocesses against one
     # persistent-cache dir — the trajectory finally records the 120 s
     # first-compile problem AND whether the cache removes it on restart.
@@ -535,6 +678,7 @@ def main() -> None:
                 "fp_impl_legs": impl_legs,
                 "stage_latency": headline.get("stage_latency", {}),
                 "scheduler_leg": scheduler_leg,
+                "planner_leg": planner_leg,
                 "startup": startup,
                 "buckets": buckets,
             }

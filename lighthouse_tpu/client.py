@@ -48,6 +48,11 @@ class ClientConfig:
     scheduler_deadline_ms: float = 25.0
     scheduler_max_batch_sets: int = 256
     scheduler_max_queue_sets: int = 2048
+    # shape-aware flush planner (verification_service/planner.py):
+    # kind-homogeneous bin-packed sub-batches when they beat the legacy
+    # single-rung flush. None = LIGHTHOUSE_TPU_SCHED_PLANNER env
+    # (default on); False pins the legacy plan.
+    scheduler_plan_flushes: Optional[bool] = None
     # AOT warmup + warm-shape routing + persistent executable caching for
     # the staged device pipeline (compile_service/); only effective with
     # bls_backend="tpu". None cache dir = LIGHTHOUSE_TPU_COMPILE_CACHE_DIR
@@ -359,6 +364,7 @@ class ClientBuilder:
                 max_batch_sets=cfg.scheduler_max_batch_sets,
                 max_queue_sets=cfg.scheduler_max_queue_sets,
                 compile_service=csvc,
+                plan_flushes=cfg.scheduler_plan_flushes,
             ).start()
 
         processor = _build_processor(chain, cfg.n_workers)

@@ -47,20 +47,31 @@ from collections import deque
 from typing import Callable, Iterable, Optional, Sequence, Tuple
 
 from ..utils import flight_recorder, metrics, tracing
+from ..verification_service import planner as _planner
 from ..verification_service import round_up_bucket
 from . import cache as _cache
 
 Rung = Tuple[int, int, int]  # (B, K, M) padded bucket shape
 
 # Ladder walk order (priority): the gossip-aggregate headline bucket
-# first (the 120 s problem in BENCH_r05), then the scheduler's large
-# fused bucket, then descending rungs for trickle/single-set traffic.
-# K=16/M=8 are the bench headline pads (committee sets pad K up; the
-# message-dedup plane rarely exceeds 8 uniques per flush).
+# first (the 120 s problem in BENCH_r05), then the flush planner's
+# kind-homogeneous sub-batch shapes (ISSUE 6: a planned split of the
+# 48-set headline flush lands unaggregated/sync-message sets on K=1
+# rungs and committee sets on small-B K=16 rungs), the intermediate
+# B rungs (48/96/192) the bin-packer targets for the observed traffic
+# shapes, then the scheduler's large fused bucket and descending rungs
+# for trickle/single-set traffic. K=16/M=8 are the bench headline pads
+# (committee sets pad K up; the message-dedup plane rarely exceeds 8
+# uniques per flush).
 DEFAULT_RUNGS: Tuple[Rung, ...] = (
     (64, 16, 8),
-    (256, 16, 8),
+    (48, 16, 8),
+    (32, 1, 8),
     (16, 16, 8),
+    (64, 1, 8),
+    (256, 16, 8),
+    (96, 16, 8),
+    (192, 16, 8),
     (4, 16, 8),
     (1, 16, 8),
 )
@@ -132,29 +143,12 @@ def _env_rungs() -> Optional[Tuple[Rung, ...]]:
 def _geometry(sets) -> Tuple[int, int, int]:
     """(n_sets, max pubkeys/set, unique messages) of a flush — the three
     padded dims the packers derive, computed WITHOUT importing the
-    device stack. Items are SignatureSet objects or (sig, pks, msg)
-    triples; anything else conservatively counts as a 1-pubkey set with
-    its own message (over-reserving only risks extra padding)."""
-    n = 0
-    k = 1
-    msgs = set()
-    distinct = 0
-    for item in sets:
-        n += 1
-        keys = getattr(item, "signing_keys", None)
-        msg = getattr(item, "message", None)
-        if keys is None and isinstance(item, (tuple, list)) and len(item) == 3:
-            keys, msg = item[1], item[2]
-        if keys is not None:
-            k = max(k, len(keys) or 1)
-        if msg is not None:
-            try:
-                msgs.add(bytes(msg))
-            except (TypeError, ValueError):
-                distinct += 1
-        else:
-            distinct += 1
-    return n, k, max(1, len(msgs) + distinct)
+    device stack. ONE definition, shared with the flush planner
+    (verification_service/planner.py): items are SignatureSet objects or
+    (sig, pks, msg) triples; anything else conservatively counts as a
+    1-pubkey set with its own message (over-reserving only risks extra
+    padding)."""
+    return _planner.flush_geometry(sets)
 
 
 class WarmShapeRegistry:
@@ -195,17 +189,14 @@ class WarmShapeRegistry:
         self, n_sets: int, k_req: int, m_req: int, impl: str
     ) -> Optional[Rung]:
         """Cheapest warm rung that can hold the request padded up
-        (B >= n_sets, K >= k_req, M >= m_req), minimizing padded device
-        work (B*K first). None when nothing warm covers it."""
+        (B >= n_sets, K >= k_req, M >= m_req). ONE covering policy:
+        delegates to ``planner.best_covering_rung`` (min padded lanes
+        B*K*M), so the rung the flush planner scores a sub-batch at is
+        the rung this routing actually lands it on. None when nothing
+        warm covers it."""
         with self._lock:
-            cands = [
-                (b, k, m)
-                for (b, k, m, i) in self._warm
-                if i == impl and b >= n_sets and k >= k_req and m >= m_req
-            ]
-        if not cands:
-            return None
-        return min(cands, key=lambda r: (r[0] * r[1], r[0], r[1], r[2]))
+            warm = [(b, k, m) for (b, k, m, i) in self._warm if i == impl]
+        return _planner.best_covering_rung(warm, n_sets, k_req, m_req)
 
     def warm_rungs(self) -> list:
         with self._lock:
@@ -372,12 +363,18 @@ class CompileService:
             }
         return {"action": "shed", "rung": None, "exact": exact, "fp_impl": impl}
 
-    def decide_flush(self, sets, caller: str = "flush") -> dict:
+    def decide_flush(
+        self, sets, caller: str = "flush",
+        geometry: Optional[Tuple[int, int, int]] = None,
+    ) -> dict:
         """The scheduler-facing entry: route the flush, account cold
         buckets (``compile_service_cold_routes_total``, ``cold_route``
         journal event) and queue the exact rung for background
-        compilation so the NEXT flush of this shape runs on device."""
-        n, k, m = _geometry(sets)
+        compilation so the NEXT flush of this shape runs on device.
+        ``geometry`` is the caller's precomputed (n_sets, k_req, m_req)
+        — the flush planner already derived it per plan element, so it
+        is not re-extracted from the sets here."""
+        n, k, m = geometry if geometry is not None else _geometry(sets)
         decision = self.route(n, k, m)
         if decision["action"] == "padded" and get_active_service() is not self:
             # the pad-up itself happens inside the device backend, which
@@ -414,6 +411,17 @@ class CompileService:
             )
             self.request(eb, ek, em)
         return decision
+
+    def warm_rungs_active(self) -> list:
+        """Warm (B, K, M) rungs under the ACTIVE fp engine — the rung
+        set the flush planner bin-packs onto (a planned sub-batch must
+        land warm or the plan falls back to the single rung)."""
+        impl = self._impl()
+        return [
+            (b, k, m)
+            for (b, k, m, i) in self.registry.warm_rungs()
+            if i == impl
+        ]
 
     def pads_for(self, n_sets: int, k_req: int, m_req: int) -> Optional[Rung]:
         """Pad target for the device packers: the warm rung a

@@ -418,7 +418,12 @@ _LANES = metrics.counter_vec(
 )
 _PAD_WASTE = metrics.gauge(
     "bls_device_padding_waste_ratio",
-    "1 - real pubkey slots / (B*K) for the most recent packed batch",
+    "1 - live lanes / padded lanes (B*K*M) for the most recent packed "
+    "batch — the SAME formula as verification_scheduler_padding_waste_"
+    "ratio (verification_service/planner.py; formula equality pinned "
+    "by test). Values differ under a planned multi-sub-batch flush: "
+    "this gauge holds the LAST packed batch, the scheduler gauge the "
+    "whole plan",
 )
 _OUTCOMES = metrics.counter_vec(
     "bls_device_verify_outcomes_total",
@@ -555,7 +560,15 @@ def verify_batch_raw_staged(
 # Host backend: padding, bucketing, randomness, reference edge semantics
 # ---------------------------------------------------------------------------
 
-def _round_up(n: int, choices=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)) -> int:
+# 48/96/192 are intermediate rungs for the flush planner's bin-packed
+# sub-batches (verification_service/planner.py): observed traffic
+# shapes a pure power-of-two ladder padded up to 64/128/256. The
+# scheduler mirrors this tuple as BUCKET_LADDER (jax-free); the two are
+# pinned equal by tests/test_verification_scheduler.py.
+def _round_up(
+    n: int,
+    choices=(1, 2, 4, 8, 16, 32, 48, 64, 96, 128, 192, 256, 512, 1024),
+) -> int:
     for c in choices:
         if n <= c:
             return c
@@ -845,7 +858,17 @@ class TpuBackend:
             _LANES.with_labels(dim, "requested").inc(req)
             _LANES.with_labels(dim, "padded").inc(pad)
         real_slots = sum(len(pks) for _, pks, _ in sets)
-        _PAD_WASTE.set(1.0 - real_slots / float(b_pad * k_pad))
+        # ONE waste definition across the stack (lazy import: the
+        # planner module is jax-free, but this module must not pull the
+        # verification_service package in at import time)
+        from ...verification_service import planner as _planner
+
+        _PAD_WASTE.set(
+            _planner.padding_waste_ratio(
+                _planner.live_lanes(real_slots, m_req),
+                _planner.padded_lanes(b_pad, k_pad, m_pad),
+            )
+        )
 
     # -- single-set entry points (same device program, B=1 semantics) ----
 

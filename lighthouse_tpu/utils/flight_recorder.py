@@ -68,6 +68,7 @@ EVENT_KINDS = (
     "queue_shed",             # beacon_processor/processor.py
     "scheduler_bisection",    # verification_service/batcher.py, per split
     "scheduler_flush",        # verification_service/batcher.py, per batch
+    "scheduler_plan",         # verification_service/batcher.py, per flush plan
     "scheduler_shed",         # verification_service/batcher.py, backpressure
     "sync_rejected",          # beacon_chain/sync_committee_verification.py
 )

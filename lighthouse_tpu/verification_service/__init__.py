@@ -3,7 +3,11 @@ gossip verifiers and the BLS backend (see ``batcher.py``). Callers
 submit signature sets; the scheduler fuses submissions from many
 producers into shared fixed-geometry device batches under a latency
 deadline, with split-and-retry isolation so per-submission verdicts
-stay identical to direct per-caller calls."""
+stay identical to direct per-caller calls. At flush time the
+shape-aware planner (``planner.py``) partitions the fused submissions
+into kind-homogeneous, bin-packed sub-batches when that reduces padded
+device lanes, falling back to the legacy single-rung flush when it
+cannot win."""
 
 from .batcher import (
     BUCKET_LADDER,
@@ -14,13 +18,31 @@ from .batcher import (
     round_up_bucket,
     scheduler_of,
 )
+from .planner import (
+    FlushPlan,
+    FlushPlanner,
+    PlannedSubBatch,
+    flush_geometry,
+    live_lanes,
+    padded_lanes,
+    padding_waste_ratio,
+    set_geometry,
+)
 
 __all__ = [
     "BUCKET_LADDER",
+    "FlushPlan",
+    "FlushPlanner",
+    "PlannedSubBatch",
     "VerificationScheduler",
     "backend_verify",
     "backend_verify_each",
     "backend_verify_now",
+    "flush_geometry",
+    "live_lanes",
+    "padded_lanes",
+    "padding_waste_ratio",
     "round_up_bucket",
     "scheduler_of",
+    "set_geometry",
 ]

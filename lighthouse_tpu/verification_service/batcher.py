@@ -45,6 +45,16 @@ without bound.
 Latency-critical callers (block verification) use :meth:`verify_now`,
 a counted synchronous bypass that never waits on a deadline.
 
+Flush planning (ISSUE 6): a flush is no longer padded wholesale onto
+one ladder rung. The shape-aware planner (:mod:`.planner`) partitions
+the fused submission list into kind-homogeneous, B-axis bin-packed
+sub-batches when that reduces total padded device lanes (B*K*M), and
+falls back to the legacy single-rung plan when it cannot win — or when
+the split would leave a warm single rung for cold ones. Each sub-batch
+gets its own backend dispatch, its own compile-service routing
+decision, and its own bisection scope; submissions stay atomic, so
+per-submission futures and verdict identity are untouched.
+
 Cold-bucket protection (ISSUE 5): with a
 :class:`~lighthouse_tpu.compile_service.CompileService` attached, every
 flush (and every ``verify_now`` bypass) is routed first — a batch whose
@@ -71,8 +81,11 @@ from ..utils import flight_recorder, metrics, tracing
 
 # Mirrors crypto/device/bls._round_up's choices without importing the
 # device stack (jax) here; tests/test_verification_scheduler.py pins the
-# two ladders equal so they cannot drift apart.
-BUCKET_LADDER = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
+# two ladders equal so they cannot drift apart. 48/96/192 are the
+# intermediate rungs the flush planner (planner.py) bin-packs onto —
+# observed traffic shapes (the 48-set headline flush, 96/192 backfill
+# bursts) that a pure power-of-two ladder padded up to 64/128/256.
+BUCKET_LADDER = (1, 2, 4, 8, 16, 32, 48, 64, 96, 128, 192, 256, 512, 1024)
 
 
 def round_up_bucket(n: int, ladder: Sequence[int] = BUCKET_LADDER) -> int:
@@ -102,8 +115,10 @@ def _env_int(name: str, default: int) -> int:
 
 _FUSED_BATCHES = metrics.counter_vec(
     "verification_scheduler_fused_batches_total",
-    "fused device batches dispatched, labeled by the sorted caller-kind "
-    "mix (e.g. aggregate+sync_message+unaggregated)",
+    "backend batches dispatched (one per sub-batch under a planned "
+    "split), labeled by the sorted caller-kind mix — mixed labels "
+    "(e.g. aggregate+sync_message+unaggregated) appear on single-rung "
+    "flushes; a planned split dispatches kind-homogeneous labels",
     ("kinds",),
 )
 _SUBMISSIONS = metrics.counter_vec(
@@ -124,12 +139,18 @@ _FLUSHES = metrics.counter_vec(
 )
 _OCCUPANCY = metrics.gauge(
     "verification_scheduler_batch_occupancy_ratio",
-    "real sets / padded ladder bucket of the most recent fused batch",
+    "live lanes / padded lanes (B*K*M, the shared formula in "
+    "verification_service/planner.py) of the most recent flush's "
+    "DEVICE-dispatched sub-batches; sub-batches shed to the CPU "
+    "fallback are excluded",
 )
 _PAD_WASTE = metrics.gauge(
     "verification_scheduler_padding_waste_ratio",
-    "1 - occupancy of the most recent fused batch (the lanes the device "
-    "pays for that no caller asked for)",
+    "1 - occupancy of the most recent device-dispatched flush plan "
+    "(the lanes the device pays for that no caller asked for) — the "
+    "SAME formula as bls_device_padding_waste_ratio (equality pinned "
+    "per geometry by test; under a planned split this gauge aggregates "
+    "the whole plan while the device gauge holds its last sub-batch)",
 )
 _QUEUE_DEPTH = metrics.gauge(
     "verification_scheduler_queue_depth",
@@ -154,6 +175,30 @@ _BYPASS = metrics.counter_vec(
     "synchronous verify_now calls (latency-critical callers, e.g. block "
     "verification) that skip the fusing queue",
     ("kind",),
+)
+_PLANS = metrics.counter_vec(
+    "verification_scheduler_plans_total",
+    "flush-planner decisions: planned = kind-homogeneous bin-packed "
+    "sub-batches, single = the legacy one-rung flush (planner "
+    "disabled, could not win, or would go cold while the single rung "
+    "is warm)",
+    ("mode",),
+)
+_PLAN_SUBBATCHES = metrics.counter_vec(
+    "verification_scheduler_plan_subbatches_total",
+    "sub-batches dispatched by the flush planner, labeled by the "
+    "sub-batch's sorted caller-kind mix (kind-homogeneous under a "
+    "planned split)",
+    ("kind",),
+)
+_PLAN_LANES = metrics.counter_vec(
+    "verification_scheduler_plan_lanes_total",
+    "device lanes (B*K*M cells) of DEVICE-dispatched sub-batches: live "
+    "= lanes callers asked for, padded = lanes of the rung the flush "
+    "actually routed to (the shared padding-waste formula, "
+    "verification_service/planner.py). Sub-batches shed to the CPU "
+    "fallback are not counted — the device paid nothing for them",
+    ("lane",),
 )
 
 
@@ -180,11 +225,25 @@ class VerificationScheduler:
         max_batch_sets: int | None = None,
         max_queue_sets: int | None = None,
         compile_service=None,
+        plan_flushes: bool | None = None,
+        flush_planner=None,
     ):
         self._verify = verify_fn or bls.verify_signature_sets
         # warm-shape router (compile_service/service.py); None = every
         # flush dispatches directly, cold compiles and all
         self._compile_service = compile_service
+        # shape-aware flush planner (planner.py): partitions a fused
+        # flush into kind-homogeneous bin-packed sub-batches when that
+        # beats the legacy single-rung pad-up. plan_flushes=False (or
+        # LIGHTHOUSE_TPU_SCHED_PLANNER=0) pins the legacy plan. Lazy
+        # import: planner.py imports this module's ladder.
+        from . import planner as _planner_mod
+
+        self._planner = (
+            flush_planner
+            if flush_planner is not None
+            else _planner_mod.FlushPlanner(enabled=plan_flushes)
+        )
         self.deadline_s = (
             deadline_ms
             if deadline_ms is not None
@@ -214,6 +273,9 @@ class VerificationScheduler:
         self._shed = 0
         self._buckets_seen: set[int] = set()
         self._last_occupancy = 0.0
+        self._plans_planned = 0
+        self._plans_single = 0
+        self._last_plan: Optional[dict] = None
 
     # -- lifecycle --------------------------------------------------------
 
@@ -387,52 +449,129 @@ class VerificationScheduler:
 
     def _flush_batch(self, subs: List[_Submission], trigger: str) -> None:
         n_sets = sum(len(s.sets) for s in subs)
-        bucket = round_up_bucket(n_sets)
         kinds_mix = "+".join(sorted({s.kind for s in subs}))
         now = time.monotonic()
         for s in subs:
             _QUEUE_WAIT.observe(now - s.submitted_at)
             _SETS_TOTAL.with_labels(s.kind).inc(len(s.sets))
-        occupancy = n_sets / float(bucket)
-        _FUSED_BATCHES.with_labels(kinds_mix).inc()
-        _FLUSHES.with_labels(trigger).inc()
-        _OCCUPANCY.set(occupancy)
-        _PAD_WASTE.set(1.0 - occupancy)
-        self._fused_batches += 1
-        self._buckets_seen.add(bucket)
-        self._last_occupancy = occupancy
-        bisections_before = self._bisections
-        # cold-bucket routing: a flush whose padded rung has no compiled
-        # staged program is served through the compile service's counted
-        # synchronous fallback (and bisects there too — verdict identity
-        # holds leaf by leaf) while the rung compiles in the background
-        verify = self._verify
-        route_action = "direct"
-        fused = [st for s in subs for st in s.sets]  # flattened ONCE
         svc = self._compile_service
-        if svc is not None and svc.active():
-            decision = svc.decide_flush(fused, caller=f"flush:{trigger}")
-            route_action = decision["action"]
-            if route_action == "shed":
-                verify = svc.fallback_verify
+        if svc is not None and not svc.active():
+            svc = None
+        # the plan: one legacy-style sub-batch, or kind-homogeneous
+        # bin-packed sub-batches when that wins on padded lanes
+        # (planner.py). With a compile service attached the planner only
+        # splits onto rungs the warm registry can serve.
+        warm = None
+        if svc is not None:
+            try:
+                warm = svc.warm_rungs_active()
+            except Exception:
+                warm = None
+        plan = self._planner.plan(subs, warm_rungs=warm)
+        _PLANS.with_labels(plan.mode).inc()
+        _FLUSHES.with_labels(trigger).inc()
+        waste = plan.waste()
+        if plan.mode == "planned":
+            self._plans_planned += 1
+        else:
+            self._plans_single += 1
+        self._last_plan = {
+            "mode": plan.mode,
+            "n_sub_batches": len(plan.sub_batches),
+            "rungs": plan.rungs_label(),
+            "padding_waste": round(waste, 4),
+        }
+        bisections_before = self._bisections
+        all_ok = True
+        dev_live = dev_padded = 0  # lanes of DEVICE-dispatched sub-batches
         with tracing.span(
             "scheduler.flush",
             trigger=trigger,
             kinds=kinds_mix,
             n_submissions=len(subs),
             n_sets=n_sets,
-            route=route_action,
+            mode=plan.mode,
+            n_sub_batches=len(plan.sub_batches),
         ) as sp:
-            all_ok = self._resolve_group(subs, verify, fused=fused)
+            for sb in plan.sub_batches:
+                # cold-bucket routing PER PLAN ELEMENT: a sub-batch whose
+                # padded rung has no compiled staged program is served
+                # through the compile service's counted synchronous
+                # fallback (and bisects there too — verdict identity
+                # holds leaf by leaf) while the rung compiles behind it
+                verify = self._verify
+                route_action = "direct"
+                paid = sb.padded
+                if svc is not None:
+                    decision = svc.decide_flush(
+                        sb.sets,
+                        caller=f"flush:{trigger}",
+                        geometry=(sb.n_sets, sb.k_req, sb.m_req),
+                    )
+                    route_action = decision["action"]
+                    if route_action == "shed":
+                        verify = svc.fallback_verify
+                    elif decision["rung"] is not None:
+                        # the registry may have warmed between planning
+                        # and routing: charge the rung the device will
+                        # ACTUALLY pad to, not the one the plan assumed
+                        rb, rk, rm = decision["rung"]
+                        paid = rb * rk * rm
+                _FUSED_BATCHES.with_labels(sb.kinds).inc()
+                _PLAN_SUBBATCHES.with_labels(sb.kinds).inc()
+                if route_action != "shed":
+                    # a shed sub-batch runs on the CPU fallback: the
+                    # device paid no lanes for it
+                    _PLAN_LANES.with_labels("live").inc(sb.live)
+                    _PLAN_LANES.with_labels("padded").inc(paid)
+                    dev_live += sb.live
+                    dev_padded += paid
+                self._fused_batches += 1
+                self._buckets_seen.add(sb.rung[0])
+                with tracing.span(
+                    "scheduler.sub_batch",
+                    kinds=sb.kinds,
+                    n_sets=sb.n_sets,
+                    rung="x".join(str(v) for v in sb.rung),
+                    route=route_action,
+                ):
+                    ok = self._resolve_group(sb.subs, verify, fused=sb.sets)
+                all_ok = all_ok and ok
             sp.set(verdict=all_ok)
+        if dev_padded:
+            # gauges describe device lanes only (consistent with
+            # verification_scheduler_plan_lanes_total): an all-shed
+            # flush dispatched nothing and leaves them untouched
+            occupancy = dev_live / float(dev_padded)
+            _OCCUPANCY.set(occupancy)
+            _PAD_WASTE.set(1.0 - occupancy)
+            self._last_occupancy = occupancy
+        flight_recorder.record(
+            "scheduler_plan",
+            mode=plan.mode,
+            n_submissions=len(subs),
+            n_sets=n_sets,
+            n_sub_batches=len(plan.sub_batches),
+            rungs=plan.rungs_label(),
+            live_lanes=plan.live,
+            padded_lanes=plan.padded,
+            legacy_padded_lanes=plan.legacy_padded,
+            waste=round(waste, 4),
+            kinds=kinds_mix,
+        )
         flight_recorder.record(
             "scheduler_flush",
             trigger=trigger,
             kinds=kinds_mix,
             n_submissions=len(subs),
             n_sets=n_sets,
-            bucket=bucket,
-            occupancy=round(occupancy, 4),
+            bucket=(
+                plan.sub_batches[0].rung[0]
+                if plan.mode == "single"
+                else None
+            ),
+            mode=plan.mode,
+            occupancy=round(1.0 - waste, 4),  # plan-wide (journal = plan record)
             verdict=all_ok,
             bisections=self._bisections - bisections_before,
         )
@@ -521,6 +660,13 @@ class VerificationScheduler:
             "last_batch_occupancy": round(self._last_occupancy, 4),
             "buckets_seen": sorted(self._buckets_seen),
             "compile_service_attached": self._compile_service is not None,
+            "planner": {
+                "enabled": self._planner.enabled,
+                "overhead_lanes": self._planner.overhead_lanes,
+                "plans_planned_total": self._plans_planned,
+                "plans_single_total": self._plans_single,
+                "last_plan": self._last_plan,
+            },
         }
 
 

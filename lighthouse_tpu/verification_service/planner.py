@@ -1,0 +1,362 @@
+"""Shape-aware flush planner: bin-packed, kind-homogeneous sub-batches.
+
+The headline bench pads 48 fused sets up to ONE (B=64, K=8, M=4) rung
+and burns ``padding_waste 0.6875`` — two of every three device lanes do
+nothing, a ~3x throughput loss no kernel work can recover, because the
+committee batch-verification cost model (PAPERS.md, arxiv 2302.00418)
+scales with *padded* lanes, not live sets. The fix is the same one
+continuous-batching serving stacks use: pack heterogeneous requests into
+shape-homogeneous device batches. This module is that planner, and it is
+deliberately **jax-free** so the scheduler, the compile service, the
+tests and ``tools/flush_plan_report.py`` can all plan without touching a
+device backend.
+
+At flush time the scheduler hands the fused submission list to
+:meth:`FlushPlanner.plan`, which partitions it into one or more
+sub-batches:
+
+* **sub-bucket by kind** — attestation and sync-committee sets have
+  near-fixed (K, M) geometry per caller kind, so kind-homogeneous
+  sub-batches stop padding the K/M axes up to the mix's max (a
+  single-pubkey gossip attestation no longer pays committee-width K);
+* **bin-pack the B axis** — a kind group's submissions are first-fit-
+  decreasing packed across ladder rungs (48 -> one 48 rung; 72 -> 64+8
+  instead of 96), minimizing total padded lanes B*K*M;
+* **prefer warm rungs** — with a compile-service registry attached, a
+  sub-batch lands on the cheapest warm rung covering it; if the split
+  would go cold while the legacy single rung is warm, the planner falls
+  back to today's single-rung plan (a plan must never trade warm device
+  dispatch for a CPU-fallback shed);
+* **fall back when it can't win** — a plan is only used when its total
+  padded lanes (plus a per-extra-dispatch overhead charge) beat the
+  single-rung plan, so trickle traffic keeps fusing into one batch and
+  the per-batch fixed overhead the scheduler exists to amortize
+  (docs/COST_MODEL.md) is not re-fragmented.
+
+Submissions are ATOMIC: a submission is the verdict-isolation unit
+(split-and-retry bisection, batcher.py) and is never split across
+sub-batches — every plan covers every submission exactly once, pinned
+by ``tests/test_flush_planner.py``.
+
+This module also owns the ONE lane/padding-waste formula
+(:func:`padded_lanes` / :func:`live_lanes` /
+:func:`padding_waste_ratio`) shared by ``bls_device_padding_waste_ratio``
+(crypto/device/bls.py) and ``verification_scheduler_padding_waste_ratio``
+(batcher.py), so the two families can no longer disagree on what
+"waste" means; their equality is pinned by test.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .batcher import BUCKET_LADDER, round_up_bucket
+
+Rung = Tuple[int, int, int]  # (B, K, M) padded bucket shape
+
+# Scoring charge for every sub-batch beyond the first, in padded-lane
+# units: a dispatch pays fixed overhead (host pack, dispatch, device
+# sync) that the cost model prices at roughly this many B*K*M cells, so
+# the planner never shreds trickle traffic into tiny batches just to
+# shave a lane or two — the fusing win of the scheduler stays intact.
+DEFAULT_SUBBATCH_OVERHEAD_LANES = 16
+_ENV_OVERHEAD = "LIGHTHOUSE_TPU_SCHED_PLAN_OVERHEAD_LANES"
+_ENV_PLANNER = "LIGHTHOUSE_TPU_SCHED_PLANNER"
+
+
+# ---------------------------------------------------------------------------
+# THE lane / padding-waste formula (one definition, two metric families)
+# ---------------------------------------------------------------------------
+
+
+def padded_lanes(b: int, k: int, m: int) -> int:
+    """Device lanes a padded (B, K, M) batch pays for: the full B*K*M
+    volume — B set lanes x K pubkey slots x M message-plane slices."""
+    return int(b) * int(k) * int(m)
+
+
+def live_lanes(pk_slots: int, m_req: int) -> int:
+    """Lanes the callers actually asked for: the real pubkey slots
+    (sum of len(pks) over live sets) replicated across the m_req live
+    message-plane slices. Padding on ANY axis (B, K or M) shows up as
+    the gap to :func:`padded_lanes`."""
+    return int(pk_slots) * max(1, int(m_req))
+
+
+def padding_waste_ratio(live: int, padded: int) -> float:
+    """1 - live/padded: the fraction of paid-for device lanes no caller
+    asked for. 0.0 for an empty/degenerate batch (nothing was paid)."""
+    if padded <= 0:
+        return 0.0
+    return max(0.0, 1.0 - live / float(padded))
+
+
+# ---------------------------------------------------------------------------
+# Geometry extraction (shared with compile_service._geometry)
+# ---------------------------------------------------------------------------
+
+
+def set_geometry(item) -> Tuple[int, Optional[bytes]]:
+    """(pubkey count, hashable message key) of ONE signature set —
+    a ``SignatureSet`` object or a ``(sig, pks, msg)`` triple. Anything
+    else conservatively counts as a 1-pubkey set with an un-keyable
+    message (over-reserving only risks extra padding)."""
+    keys = getattr(item, "signing_keys", None)
+    msg = getattr(item, "message", None)
+    if keys is None and isinstance(item, (tuple, list)) and len(item) == 3:
+        keys, msg = item[1], item[2]
+    k = len(keys) if keys is not None else 1
+    if msg is None:
+        return k, None
+    try:
+        return k, bytes(msg)
+    except (TypeError, ValueError):
+        return k, None
+
+
+def flush_geometry(sets) -> Tuple[int, int, int]:
+    """(n_sets, max pubkeys/set, unique messages) of a flush — the three
+    dims the packers pad. Un-keyable messages each count distinct."""
+    n = 0
+    k = 1
+    msgs: Set[bytes] = set()
+    distinct = 0
+    for item in sets:
+        n += 1
+        ki, key = set_geometry(item)
+        k = max(k, ki or 1)
+        if key is None:
+            distinct += 1
+        else:
+            msgs.add(key)
+    return n, k, max(1, len(msgs) + distinct)
+
+
+# ---------------------------------------------------------------------------
+# Plan data model
+# ---------------------------------------------------------------------------
+
+
+class PlannedSubBatch:
+    """One dispatch of the plan: whole submissions, their live geometry,
+    and the padded rung the backend will land on."""
+
+    __slots__ = (
+        "subs", "sets", "kinds", "n_sets", "k_req", "m_req",
+        "pk_slots", "rung", "cold", "live", "padded",
+    )
+
+    def __init__(self, subs: List, rung: Rung, cold: bool,
+                 n_sets: int, k_req: int, m_req: int, pk_slots: int):
+        self.subs = subs
+        self.sets = [st for s in subs for st in s.sets]
+        self.kinds = "+".join(sorted({s.kind for s in subs}))
+        self.n_sets = n_sets
+        self.k_req = k_req
+        self.m_req = m_req
+        self.pk_slots = pk_slots
+        self.rung = rung
+        self.cold = cold
+        self.live = live_lanes(pk_slots, m_req)
+        self.padded = padded_lanes(*rung)
+
+    def waste(self) -> float:
+        return padding_waste_ratio(self.live, self.padded)
+
+
+class FlushPlan:
+    """The planner's answer: ``mode`` is ``"planned"`` (multi- or
+    better-shaped sub-batches) or ``"single"`` (today's one-rung flush,
+    the fallback). Lane totals use the shared formula above."""
+
+    __slots__ = (
+        "mode", "sub_batches", "live", "padded",
+        "legacy_rung", "legacy_padded", "legacy_cold",
+    )
+
+    def __init__(self, mode: str, sub_batches: List[PlannedSubBatch],
+                 legacy_rung: Rung, legacy_cold: bool = False):
+        self.mode = mode
+        self.sub_batches = sub_batches
+        self.live = sum(sb.live for sb in sub_batches)
+        self.padded = sum(sb.padded for sb in sub_batches)
+        self.legacy_rung = legacy_rung
+        self.legacy_padded = padded_lanes(*legacy_rung)
+        self.legacy_cold = legacy_cold
+
+    def waste(self) -> float:
+        return padding_waste_ratio(self.live, self.padded)
+
+    def rungs_label(self) -> str:
+        return "+".join(
+            f"{b}x{k}x{m}" for (b, k, m) in (sb.rung for sb in self.sub_batches)
+        )
+
+
+# ---------------------------------------------------------------------------
+# The planner
+# ---------------------------------------------------------------------------
+
+
+def best_covering_rung(
+    warm: Iterable[Rung], n: int, k: int, m: int
+) -> Optional[Rung]:
+    """Cheapest rung in ``warm`` covering (n, k, m), minimizing padded
+    lanes. THE covering policy: ``WarmShapeRegistry.best_covering``
+    (compile_service/service.py) delegates here, so the rung the
+    planner scores a sub-batch at is the rung routing actually lands
+    it on."""
+    cands = [r for r in warm if r[0] >= n and r[1] >= k and r[2] >= m]
+    if not cands:
+        return None
+    return min(cands, key=lambda r: (padded_lanes(*r), r[0], r[1], r[2]))
+
+
+def _largest_rung_at_most(n: int) -> int:
+    best = BUCKET_LADDER[0]
+    for c in BUCKET_LADDER:
+        if c <= n:
+            best = c
+    return best
+
+
+class FlushPlanner:
+    """Stateless-per-flush planner (see module docstring). ``overhead_
+    lanes`` is the scoring charge per sub-batch beyond the first;
+    ``enabled=False`` always returns the single-rung plan (the
+    pre-planner behavior, byte-identical)."""
+
+    def __init__(
+        self,
+        overhead_lanes: Optional[int] = None,
+        enabled: Optional[bool] = None,
+    ):
+        if overhead_lanes is None:
+            try:
+                overhead_lanes = int(os.environ.get(_ENV_OVERHEAD, ""))
+            except ValueError:
+                overhead_lanes = DEFAULT_SUBBATCH_OVERHEAD_LANES
+        self.overhead_lanes = max(0, int(overhead_lanes))
+        if enabled is None:
+            enabled = os.environ.get(_ENV_PLANNER, "1") not in ("", "0")
+        self.enabled = bool(enabled)
+
+    # -- public entry -----------------------------------------------------
+
+    def plan(
+        self,
+        subs: Sequence,
+        warm_rungs: Optional[Iterable[Rung]] = None,
+    ) -> FlushPlan:
+        """Partition ``subs`` (objects with ``.kind`` and ``.sets``) into
+        sub-batches. ``warm_rungs`` is the compile-service registry's
+        warm (B, K, M) set for the active engine — None means no service
+        attached (every exact rung dispatches; the packers pad to it)."""
+        warm = None if warm_rungs is None else list(warm_rungs)
+        legacy = self._make_sub_batch(list(subs), warm)
+        if not self.enabled or len(subs) == 0:
+            return FlushPlan("single", [legacy], legacy.rung, legacy.cold)
+        planned = self._kind_binpacked(list(subs), warm)
+        if len(planned) <= 1:
+            # one bin == the legacy plan re-derived; report it as single
+            # (same rung by construction: one group, one bin, whole flush)
+            return FlushPlan("single", [legacy], legacy.rung, legacy.cold)
+        # warm preference dominates the lane score in BOTH directions: a
+        # shed pays CPU wall time, not device lanes, so comparing a cold
+        # plan's padded lanes against a warm one's is apples-to-oranges.
+        # A plan that sends ANY sub-batch to the CPU fallback while the
+        # single warm rung could serve the whole flush on device is a
+        # de-optimization; conversely an all-warm split must beat a COLD
+        # single rung whatever the lane count says.
+        if warm is not None:
+            planned_cold = any(sb.cold for sb in planned)
+            if planned_cold and not legacy.cold:
+                return FlushPlan("single", [legacy], legacy.rung, legacy.cold)
+            if legacy.cold and not planned_cold:
+                return FlushPlan("planned", planned, legacy.rung, legacy.cold)
+        score = sum(sb.padded for sb in planned) + self.overhead_lanes * (
+            len(planned) - 1
+        )
+        if score >= legacy.padded:
+            return FlushPlan("single", [legacy], legacy.rung, legacy.cold)
+        return FlushPlan("planned", planned, legacy.rung, legacy.cold)
+
+    # -- internals --------------------------------------------------------
+
+    def _geometry_of(self, subs: List) -> Tuple[int, int, int, int]:
+        """(n_sets, k_req, m_req, live pk slots) over whole submissions."""
+        n = 0
+        k_req = 1
+        pk_slots = 0
+        msgs: Set[bytes] = set()
+        distinct = 0
+        for s in subs:
+            for item in s.sets:
+                n += 1
+                ki, key = set_geometry(item)
+                k_req = max(k_req, ki or 1)
+                pk_slots += ki
+                if key is None:
+                    distinct += 1
+                else:
+                    msgs.add(key)
+        m_req = max(1, len(msgs) + distinct)
+        return n, k_req, m_req, pk_slots
+
+    def _make_sub_batch(
+        self, subs: List, warm: Optional[List[Rung]]
+    ) -> PlannedSubBatch:
+        n, k_req, m_req, pk_slots = self._geometry_of(subs)
+        exact: Rung = (
+            round_up_bucket(max(1, n)),
+            round_up_bucket(k_req),
+            round_up_bucket(m_req),
+        )
+        cold = False
+        rung = exact
+        if warm is not None:
+            covering = best_covering_rung(warm, n, k_req, m_req)
+            if covering is not None:
+                rung = covering
+            else:
+                cold = True
+        return PlannedSubBatch(subs, rung, cold, n, k_req, m_req, pk_slots)
+
+    def _kind_binpacked(
+        self, subs: List, warm: Optional[List[Rung]]
+    ) -> List[PlannedSubBatch]:
+        """Sub-bucket by kind, then first-fit-decreasing bin-pack each
+        kind group's submissions over the B axis with bin capacity = the
+        largest ladder rung <= the group's set count (an oversized
+        submission opens its own bin — submissions never split)."""
+        groups: Dict[str, List] = {}
+        for s in subs:
+            groups.setdefault(s.kind, []).append(s)
+        planned: List[PlannedSubBatch] = []
+        for kind in sorted(groups):
+            members = groups[kind]
+            n_group = sum(len(s.sets) for s in members)
+            cap = _largest_rung_at_most(max(1, n_group))
+            # stable FFD: big submissions first, arrival order tie-break
+            order = sorted(
+                range(len(members)),
+                key=lambda i: (-len(members[i].sets), i),
+            )
+            bins: List[List] = []  # [submissions, set count]
+            for i in order:
+                sub = members[i]
+                size = len(sub.sets)
+                placed = False
+                for b in bins:
+                    if b[1] + size <= cap:
+                        b[0].append(sub)
+                        b[1] += size
+                        placed = True
+                        break
+                if not placed:
+                    # a submission larger than cap still gets its own bin
+                    bins.append([[sub], size])
+            for members_bin, _count in bins:
+                planned.append(self._make_sub_batch(members_bin, warm))
+        return planned

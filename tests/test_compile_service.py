@@ -75,7 +75,7 @@ def test_registry_route_warm_padded_shed():
     # a warm rung that cannot HOLD the request never serves it
     d = svc.route(64, k_req=8, m_req=1)
     assert d["action"] == "shed"
-    # cheapest covering rung wins (min padded device work B*K)
+    # cheapest covering rung wins (min padded device lanes B*K*M)
     svc.registry.mark_ready((8, 2, 4), IMPL)
     d = svc.route(5, k_req=2, m_req=2)
     assert d["rung"] == (8, 2, 4)

@@ -1,0 +1,345 @@
+"""Shape-aware flush planner (ISSUE 6): kind-homogeneous, bin-packed
+sub-batches replacing the pad-everything-to-one-rung flush.
+
+Covers the planner contract (every plan covers every submission exactly
+once — no drop, no duplicate), the kind split that kills the headline
+padding waste, B-axis bin-packing onto the intermediate ladder rungs,
+warm-rung preference with single-rung fallback, poison isolation scoped
+to the failing SUB-BATCH (not the whole flush), the ONE shared
+lane/padding-waste formula pinned equal between
+``bls_device_padding_waste_ratio`` and
+``verification_scheduler_padding_waste_ratio``, and the jax-free
+``tools/flush_plan_report.py`` CLI."""
+
+import json
+import random
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from lighthouse_tpu.crypto import backend, bls
+from lighthouse_tpu.utils import flight_recorder, metrics
+from lighthouse_tpu.verification_service import (
+    BUCKET_LADDER,
+    VerificationScheduler,
+    round_up_bucket,
+)
+from lighthouse_tpu.verification_service import planner as planner_mod
+
+KINDS = ("unaggregated", "aggregate", "sync_message", "sync_contribution")
+
+
+class Sub:
+    """Planner-facing submission shape (kind + sets)."""
+
+    def __init__(self, kind, sets):
+        self.kind = kind
+        self.sets = sets
+
+
+def _triples(n, k=1, msgs=1, salt=0):
+    """n synthetic (sig, pks, msg) geometry-only sets with k pubkeys
+    each over ``msgs`` distinct messages."""
+    return [
+        (None, [None] * k, bytes([salt + i % msgs + 1]) * 32)
+        for i in range(n)
+    ]
+
+
+def test_intermediate_rungs_on_both_ladders():
+    """48/96/192 exist (the planner's bin-pack targets for observed
+    traffic shapes) and the scheduler mirror still equals the device
+    packer's ladder — including the new rungs."""
+    from lighthouse_tpu.crypto.device.bls import _round_up
+
+    for rung in (48, 96, 192):
+        assert rung in BUCKET_LADDER, rung
+    assert tuple(_round_up.__defaults__[0]) == BUCKET_LADDER
+    for n in (33, 48, 49, 65, 96, 100, 129, 192, 193):
+        assert round_up_bucket(n) == _round_up(n), n
+
+
+def test_every_plan_covers_all_submissions_exactly_once():
+    """Property-style: across random traffic shapes and warm-registry
+    states, a plan partitions the submissions — nothing dropped,
+    nothing duplicated, set counts preserved, every B rung on the
+    ladder, and a planned split never pays more padded lanes than the
+    single-rung plan it replaced."""
+    rng = random.Random(0xBE5)
+    planner = planner_mod.FlushPlanner()
+    for trial in range(60):
+        subs = [
+            Sub(
+                rng.choice(KINDS),
+                _triples(
+                    rng.randint(1, 9),
+                    k=rng.choice((1, 2, 8)),
+                    msgs=rng.randint(1, 3),
+                ),
+            )
+            for _ in range(rng.randint(1, 14))
+        ]
+        warm = rng.choice(
+            (
+                None,
+                [],
+                [(64, 8, 4), (16, 2, 4)],
+                [(1024, 1024, 1024)],
+            )
+        )
+        plan = planner.plan(subs, warm_rungs=warm)
+        seen = [id(s) for sb in plan.sub_batches for s in sb.subs]
+        assert sorted(seen) == sorted(id(s) for s in subs), trial
+        assert sum(sb.n_sets for sb in plan.sub_batches) == sum(
+            len(s.sets) for s in subs
+        )
+        for sb in plan.sub_batches:
+            assert sb.n_sets == len(sb.sets)
+            assert sb.rung[0] in BUCKET_LADDER or sb.rung[0] % 1024 == 0
+            # the rung covers the sub-batch's live geometry (warm rungs
+            # may exceed it; exact rungs are the round-up)
+            assert sb.rung[0] >= sb.n_sets
+            assert sb.rung[1] >= sb.k_req
+            assert sb.rung[2] >= sb.m_req
+        if plan.mode == "planned":
+            assert len(plan.sub_batches) > 1
+            # a planned split either wins on padded lanes, or was chosen
+            # because it is all-warm while the single rung is cold (a
+            # shed costs CPU wall time, not device lanes)
+            assert plan.padded < plan.legacy_padded or (
+                plan.legacy_cold
+                and not any(sb.cold for sb in plan.sub_batches)
+            )
+        else:
+            assert len(plan.sub_batches) == 1
+
+
+def test_kind_homogeneous_split_kills_headline_padding_waste():
+    """The headline mix (32 single-pubkey sets + 16 committee-width
+    sets, 4 unique messages) plans to kind-homogeneous sub-batches with
+    padding_waste < 0.15 — the ISSUE 6 acceptance bar — where the
+    single-rung plan burns ~0.58."""
+    subs = [Sub("unaggregated", _triples(4, k=1, msgs=4)) for _ in range(8)]
+    subs += [Sub("aggregate", _triples(4, k=8, msgs=4)) for _ in range(4)]
+    plan = planner_mod.FlushPlanner().plan(subs)
+    assert plan.mode == "planned"
+    assert len(plan.sub_batches) >= 2
+    for sb in plan.sub_batches:
+        assert "+" not in sb.kinds, "sub-batches must be kind-homogeneous"
+    assert plan.waste() < 0.15, plan.rungs_label()
+    legacy_waste = planner_mod.padding_waste_ratio(
+        plan.live, plan.legacy_padded
+    )
+    assert legacy_waste > 0.5  # what the old single-rung flush burned
+
+
+def test_bin_packing_prefers_exact_and_split_rungs():
+    """48 one-set submissions land on the new exact 48 rung (one bin);
+    72 split 64+8 instead of padding to 96."""
+    planner = planner_mod.FlushPlanner()
+    p48 = planner.plan([Sub("unaggregated", _triples(1)) for _ in range(48)])
+    assert [sb.rung[0] for sb in p48.sub_batches] == [48]
+    p72 = planner.plan([Sub("unaggregated", _triples(1)) for _ in range(72)])
+    assert sorted(sb.rung[0] for sb in p72.sub_batches) == [8, 64]
+    assert p72.mode == "planned"
+    assert p72.padded < planner_mod.padded_lanes(96, 1, 1)
+
+
+def test_warm_rung_preference_and_single_rung_fallback():
+    """With a compile-service registry: a split that would go COLD while
+    the single rung is warm falls back to the single-rung plan; a split
+    whose rungs are warm is taken; tiny traffic never splits at all."""
+    subs = [Sub("unaggregated", _triples(4, k=1, msgs=4)) for _ in range(8)]
+    subs += [Sub("aggregate", _triples(4, k=8, msgs=4)) for _ in range(4)]
+    planner = planner_mod.FlushPlanner()
+
+    only_legacy_warm = planner.plan(subs, warm_rungs=[(48, 8, 4)])
+    assert only_legacy_warm.mode == "single"
+    assert only_legacy_warm.sub_batches[0].rung == (48, 8, 4)
+    assert not only_legacy_warm.sub_batches[0].cold
+
+    split_warm = planner.plan(subs, warm_rungs=[(32, 1, 4), (16, 8, 4)])
+    assert split_warm.mode == "planned"
+    assert sorted(sb.rung for sb in split_warm.sub_batches) == [
+        (16, 8, 4), (32, 1, 4),
+    ]
+    assert not any(sb.cold for sb in split_warm.sub_batches)
+
+    # nothing warm at all: both alternatives shed, so the lane count
+    # decides and the sub-batches are marked cold (demand-paged rungs)
+    all_cold = planner.plan(subs, warm_rungs=[])
+    assert all(sb.cold for sb in all_cold.sub_batches)
+
+    # warm-ness dominates the lane score in the OTHER direction too: a
+    # COLD single rung (CPU shed) must lose to an all-warm split even
+    # when the warm covering rungs pay more padded lanes — a shed costs
+    # CPU wall time, not device lanes, so the scores are not comparable
+    # (32,16,8) covers the unaggregated sub-batch but NOT the 48-set
+    # legacy rung, so the single plan is cold while the split is warm
+    expensive_warm = planner.plan(subs, warm_rungs=[(32, 16, 8), (16, 8, 4)])
+    assert expensive_warm.mode == "planned"
+    assert not any(sb.cold for sb in expensive_warm.sub_batches)
+    assert expensive_warm.padded > expensive_warm.legacy_padded
+
+    # trickle traffic: the per-sub-batch overhead charge keeps it fused
+    tiny = [Sub(k, _triples(1)) for k in KINDS[:3]]
+    assert planner.plan(tiny).mode == "single"
+
+
+def test_planner_disabled_pins_legacy_single_rung():
+    subs = [Sub("unaggregated", _triples(4, k=1, msgs=4)) for _ in range(8)]
+    subs += [Sub("aggregate", _triples(4, k=8, msgs=4)) for _ in range(4)]
+    plan = planner_mod.FlushPlanner(enabled=False).plan(subs)
+    assert plan.mode == "single"
+    assert len(plan.sub_batches) == 1
+    assert plan.sub_batches[0].rung == plan.legacy_rung
+
+
+# -- scheduler-level behavior (fake backend) --------------------------------
+
+
+@pytest.fixture
+def fake_backend():
+    backend.set_backend("fake")
+    yield
+    backend.set_backend("cpu")
+
+
+_SK = bls.SecretKey(7)
+_PK = bls.PublicKey.deserialize(_SK.public_key().serialize())
+_MSG = b"\x11" * 32
+_SIG = bls.Signature.deserialize(_SK.sign(_MSG).serialize())
+
+
+def _set(n_pks: int = 1) -> bls.SignatureSet:
+    return bls.SignatureSet.multiple_pubkeys(_SIG, [_PK] * n_pks, _MSG)
+
+
+def _poisoned() -> bls.SignatureSet:
+    return bls.SignatureSet.multiple_pubkeys(_SIG, [], _MSG)
+
+
+def test_planned_flush_bisects_only_within_the_failing_subbatch(fake_backend):
+    """Traffic big enough to split: the unaggregated sub-batch and the
+    aggregate sub-batch dispatch separately; a poisoned aggregate
+    submission is bisected INSIDE its sub-batch — every bisection event
+    carries only 'aggregate' kinds and the unaggregated callers resolve
+    True without ever re-verifying."""
+    ev_seq = max(
+        (e["seq"] for e in flight_recorder.events(["scheduler_bisection"])),
+        default=-1,
+    )
+    plans_before = (
+        metrics.get("verification_scheduler_plans_total")
+        .with_labels("planned").value
+    )
+    sched = VerificationScheduler(
+        deadline_ms=60_000.0, max_batch_sets=32, max_queue_sets=1024,
+    ).start()
+    try:
+        good = [
+            sched.submit([_set() for _ in range(4)], "unaggregated")
+            for _ in range(6)
+        ]
+        bad = sched.submit(
+            [_poisoned()] + [_set(8) for _ in range(3)], "aggregate"
+        )
+        ok = sched.submit([_set(8) for _ in range(4)], "aggregate")
+        # 24 + 4 + 4 = 32 sets -> bucket-full flush
+        assert bad.result(timeout=10) is False
+        assert ok.result(timeout=10) is True
+        assert [f.result(timeout=10) for f in good] == [True] * 6
+    finally:
+        sched.stop()
+    st = sched.status()
+    assert st["planner"]["plans_planned_total"] >= 1
+    assert st["bisections_total"] >= 1
+    assert (
+        metrics.get("verification_scheduler_plans_total")
+        .with_labels("planned").value
+        > plans_before
+    )
+    if flight_recorder.enabled():
+        new = [
+            e
+            for e in flight_recorder.events(["scheduler_bisection"])
+            if e["seq"] > ev_seq
+        ]
+        assert new, "the poisoned sub-batch must bisect"
+        assert all(e["fields"]["kinds"] == "aggregate" for e in new), (
+            "bisection leaked outside the failing sub-batch: "
+            + repr([e["fields"] for e in new])
+        )
+        plans = [
+            e
+            for e in flight_recorder.events(["scheduler_plan"])
+            if e["seq"] > ev_seq and e["fields"]["mode"] == "planned"
+        ]
+        assert plans, "a planned flush must journal scheduler_plan"
+
+
+def test_shared_waste_formula_pins_device_and_scheduler_equal():
+    """THE satellite pin: bls_device_padding_waste_ratio and
+    verification_scheduler_padding_waste_ratio compute the same number
+    from the same geometry — one formula, two families."""
+    import numpy as np
+
+    from lighthouse_tpu.crypto.device.bls import TpuBackend, fp
+
+    B, K, M = 8, 4, 2
+    msgs = [bytes([1]) * 32, bytes([2]) * 32]
+    sets = [(None, [object()] * 3, msgs[i % 2]) for i in range(5)]
+    packed = (
+        np.zeros((B, K, 2, fp.NL), np.int32),   # pk_xy
+        np.zeros((B, K), bool),                 # pk_mask
+        np.zeros((B, 2, fp.NL), np.int32),      # sig_x
+        np.zeros((B,), bool),                   # sig_larger
+        np.zeros((M, 2, 2, fp.NL), np.int32),   # msg_u
+        np.zeros((B,), np.int32),               # msg_idx
+        np.zeros((B, 2), np.int32),             # rand
+        np.zeros((B,), bool),                   # set_mask
+    )
+    TpuBackend._record_geometry(sets, packed)
+    device_waste = metrics.get("bls_device_padding_waste_ratio").value
+
+    live = planner_mod.live_lanes(sum(len(p) for _, p, _ in sets), 2)
+    expected = planner_mod.padding_waste_ratio(
+        live, planner_mod.padded_lanes(B, K, M)
+    )
+    assert device_waste == pytest.approx(expected)
+    # 5 sets x 3 pks over 2 messages padded to (8, 4, 2):
+    # live = 15*2 = 30, padded = 64 -> waste 0.53125
+    assert device_waste == pytest.approx(1.0 - 30 / 64)
+
+    # the scheduler side reports the same number for the same geometry
+    plan = planner_mod.FlushPlanner().plan([Sub("unaggregated", sets)])
+    assert plan.sub_batches[0].rung == (B, K, M)
+    assert plan.waste() == pytest.approx(device_waste)
+
+
+def test_flush_plan_report_tool_is_jax_free():
+    """The report CLI plans the headline mix without importing jax
+    (subprocess-pinned, mirroring the warmup --dry-run discipline) and
+    its accounting matches the acceptance bar."""
+    code = (
+        "import sys, json\n"
+        "import tools.flush_plan_report as t\n"
+        "t.main(['--mix', 'unaggregated:32:1,aggregate:16:8',"
+        " '--messages', '4', '--json'])\n"
+        "assert 'jax' not in sys.modules, 'planner tool must stay jax-free'\n"
+    )
+    import os
+
+    r = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=60,
+        cwd=os.path.join(os.path.dirname(__file__), ".."),
+    )
+    assert r.returncode == 0, r.stderr
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["mode"] == "planned"
+    assert rec["padding_waste"] < 0.15
+    assert rec["legacy_padding_waste"] > 0.5
+    assert all("+" not in sb["kinds"] for sb in rec["sub_batches"])

@@ -72,7 +72,13 @@ def test_bucket_ladder_matches_device_packer():
     from lighthouse_tpu.crypto.device.bls import _round_up
 
     assert tuple(_round_up.__defaults__[0]) == BUCKET_LADDER
-    for n in (1, 2, 3, 5, 9, 17, 64, 100, 1024, 1500, 4096):
+    # the flush planner's intermediate rungs (ISSUE 6) are part of the
+    # pinned surface: dropping one from either side breaks bin-packed
+    # plans onto shapes the device never compiles
+    for rung in (48, 96, 192):
+        assert rung in BUCKET_LADDER, rung
+    for n in (1, 2, 3, 5, 9, 17, 33, 48, 64, 65, 100, 129, 192, 1024,
+              1500, 4096):
         assert round_up_bucket(n) == _round_up(n), n
 
 

@@ -162,6 +162,10 @@ def test_verification_scheduler_families_registered():
         "verification_scheduler_queue_depth": ("gauge", None),
         "verification_scheduler_queue_wait_seconds": ("histogram", None),
         "verification_scheduler_bisections_total": ("counter", None),
+        # ISSUE 6: flush-planner families (shape-aware sub-batch plans)
+        "verification_scheduler_plans_total": ("counter", ("mode",)),
+        "verification_scheduler_plan_subbatches_total": ("counter", ("kind",)),
+        "verification_scheduler_plan_lanes_total": ("counter", ("lane",)),
     }
     for name, (kind, labels) in want.items():
         m = reg.get(name)

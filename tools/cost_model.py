@@ -256,6 +256,20 @@ def main() -> None:
       "([VERIFICATION_SERVICE.md](VERIFICATION_SERVICE.md); occupancy and "
       "padding-waste gauges in "
       "[OBSERVABILITY.md](OBSERVABILITY.md)).")
+    w("- Padded-lane cost: every lane count above is charged per PADDED "
+      "lane, not per live set — the device pays B·K·M cells whatever the "
+      "occupancy, so `padding_waste = 1 − live/(B·K·M)` multiplies "
+      "straight into sets/s (the 0.6875 headline waste was a ~3.2x "
+      "throughput loss no kernel work could recover). The flush planner "
+      "splits a fused flush into kind-homogeneous, bin-packed sub-batches "
+      "precisely to shrink that factor; its scoring unit is the same "
+      "B·K·M cell this model counts, with a per-extra-dispatch overhead "
+      "charge standing in for the fixed pack+dispatch cost above "
+      "([VERIFICATION_SERVICE.md](VERIFICATION_SERVICE.md) flush-planner "
+      "section; `verification_scheduler_plan_lanes_total{live,padded}` "
+      "and the shared waste gauges in "
+      "[OBSERVABILITY.md](OBSERVABILITY.md); plans inspectable offline "
+      "via `tools/flush_plan_report.py`).")
     w("- Setup cost, not in these tables: the FIRST dispatch of each "
       "staged program at a fresh bucket shape pays the XLA compile "
       "(~120 s for the B=64 headline rung on this host, BENCH_r05 / the "

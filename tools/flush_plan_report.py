@@ -1,0 +1,250 @@
+"""Explain what the shape-aware flush planner would do with a traffic
+shape (ISSUE 6): the chosen plan, per-sub-batch rung, and padded-lane
+accounting — **jax-free**, so it runs on any host (same discipline as
+``tools/warmup.py --dry-run``).
+
+    # the headline bench mix: 32 single-pubkey gossip sets + 16
+    # committee-width aggregate sets over 4 unique messages
+    python tools/flush_plan_report.py \\
+        --mix unaggregated:32:1,aggregate:16:8 --messages 4
+
+    # constrain the plan to a warm-rung registry (what a node with a
+    # compile service attached would actually dispatch)
+    python tools/flush_plan_report.py --mix unaggregated:32:1,aggregate:16:8 \\
+        --messages 4 --warm 32:1:8,16:16:8,64:16:8
+
+    # one JSON line for scripts
+    python tools/flush_plan_report.py --sets 48 --json
+
+``--mix`` is ``kind:count:pubkeys[:messages]`` per kind;
+``--sets N`` is shorthand for one kind of N single-pubkey sets.
+Submissions default to one set each (gossip trickle); use
+``--sets-per-submission`` for burstier callers. The lane accounting is
+the ONE shared formula (``verification_service/planner.py``) both
+``bls_device_padding_waste_ratio`` and
+``verification_scheduler_padding_waste_ratio`` report.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class _Sub:
+    """Minimal submission shape the planner consumes (kind + sets)."""
+
+    __slots__ = ("kind", "sets")
+
+    def __init__(self, kind, sets):
+        self.kind = kind
+        self.sets = sets
+
+
+def _parse_mix(raw: str, default_messages: int):
+    mix = []
+    for chunk in raw.split(","):
+        chunk = chunk.strip()
+        if not chunk:
+            continue
+        parts = chunk.split(":")
+        if len(parts) not in (3, 4):
+            raise SystemExit(
+                f"malformed mix entry {chunk!r}; expected "
+                f"kind:count:pubkeys[:messages]"
+            )
+        kind = parts[0]
+        try:
+            nums = [int(p) for p in parts[1:]]
+        except ValueError:
+            raise SystemExit(f"malformed mix entry {chunk!r}: non-integer")
+        count, pubkeys = nums[0], nums[1]
+        messages = nums[2] if len(nums) == 3 else default_messages
+        if count <= 0 or pubkeys <= 0 or messages <= 0:
+            raise SystemExit(f"mix entry {chunk!r} must be all-positive")
+        mix.append((kind, count, pubkeys, messages))
+    if not mix:
+        raise SystemExit("--mix parsed to an empty traffic shape")
+    return mix
+
+
+def _parse_warm(raw: str):
+    rungs = []
+    for chunk in raw.split(","):
+        chunk = chunk.strip()
+        if not chunk:
+            continue
+        parts = chunk.split(":")
+        if len(parts) != 3:
+            raise SystemExit(f"malformed warm rung {chunk!r}; expected B:K:M")
+        try:
+            rungs.append(tuple(int(p) for p in parts))
+        except ValueError:
+            raise SystemExit(f"malformed warm rung {chunk!r}: non-integer")
+    return rungs
+
+
+def build_submissions(mix, sets_per_submission: int):
+    """Synthetic submissions carrying only the geometry the planner
+    reads: (sig=None, [None]*pubkeys, message bytes) triples, messages
+    distributed round-robin over each kind's unique-message count.
+    Message bytes are salted per KIND — real traffic's kinds sign
+    different messages, so the whole-flush unique count (the legacy
+    rung's M axis) is the sum, not the max, of the per-kind counts."""
+    subs = []
+    for kind_idx, (kind, count, pubkeys, messages) in enumerate(mix):
+        sets = [
+            (
+                None,
+                [None] * pubkeys,
+                ((kind_idx << 32) | (m % messages + 1)).to_bytes(8, "big") * 4,
+            )
+            for m in range(count)
+        ]
+        for i in range(0, count, sets_per_submission):
+            subs.append(_Sub(kind, sets[i: i + sets_per_submission]))
+    return subs
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--mix",
+        default=None,
+        help="traffic shape, kind:count:pubkeys[:messages] comma list "
+        "(e.g. unaggregated:32:1,aggregate:16:8)",
+    )
+    ap.add_argument(
+        "--sets",
+        type=int,
+        default=None,
+        help="shorthand: one kind of N single-pubkey sets",
+    )
+    ap.add_argument(
+        "--messages",
+        type=int,
+        default=4,
+        help="unique messages per kind when the mix entry omits them "
+        "(default 4)",
+    )
+    ap.add_argument(
+        "--sets-per-submission",
+        type=int,
+        default=1,
+        help="sets per submission (the atomic isolation unit; default 1 "
+        "= gossip trickle)",
+    )
+    ap.add_argument(
+        "--warm",
+        default=None,
+        help="comma list of warm B:K:M rungs (a compile-service "
+        "registry); omitted = no service, every exact rung dispatches",
+    )
+    ap.add_argument(
+        "--overhead-lanes",
+        type=int,
+        default=None,
+        help="scoring charge per extra sub-batch in B*K*M cells "
+        "(default: LIGHTHOUSE_TPU_SCHED_PLAN_OVERHEAD_LANES or 16)",
+    )
+    ap.add_argument(
+        "--json", action="store_true", help="print one summary JSON line"
+    )
+    args = ap.parse_args(argv)
+
+    if (args.mix is None) == (args.sets is None):
+        raise SystemExit("exactly one of --mix / --sets is required")
+    mix = (
+        _parse_mix(args.mix, args.messages)
+        if args.mix
+        else [("unaggregated", args.sets, 1, args.messages)]
+    )
+    if args.sets_per_submission <= 0:
+        raise SystemExit("--sets-per-submission must be positive")
+
+    # jax-free by construction: the planner package imports no device
+    # stack at import time (same property tools/warmup.py --dry-run
+    # relies on; tests/test_flush_planner.py pins it in a subprocess)
+    from lighthouse_tpu.verification_service import planner as planner_mod
+
+    warm = _parse_warm(args.warm) if args.warm else None
+    subs = build_submissions(mix, args.sets_per_submission)
+    planner = planner_mod.FlushPlanner(overhead_lanes=args.overhead_lanes)
+    plan = planner.plan(subs, warm_rungs=warm)
+
+    n_sets = sum(len(s.sets) for s in subs)
+    record = {
+        "n_sets": n_sets,
+        "n_submissions": len(subs),
+        "kinds": sorted({s.kind for s in subs}),
+        "mode": plan.mode,
+        "overhead_lanes": planner.overhead_lanes,
+        "warm_rungs": None if warm is None else [list(r) for r in warm],
+        "legacy_rung": list(plan.legacy_rung),
+        "legacy_padded_lanes": plan.legacy_padded,
+        "live_lanes": plan.live,
+        "padded_lanes": plan.padded,
+        "padding_waste": round(plan.waste(), 4),
+        "legacy_padding_waste": round(
+            planner_mod.padding_waste_ratio(plan.live, plan.legacy_padded), 4
+        ),
+        "sub_batches": [
+            {
+                "kinds": sb.kinds,
+                "n_submissions": len(sb.subs),
+                "n_sets": sb.n_sets,
+                "k_req": sb.k_req,
+                "m_req": sb.m_req,
+                "rung": list(sb.rung),
+                "cold": sb.cold,
+                "live_lanes": sb.live,
+                "padded_lanes": sb.padded,
+                "padding_waste": round(sb.waste(), 4),
+            }
+            for sb in plan.sub_batches
+        ],
+    }
+
+    if args.json:
+        print(json.dumps(record))
+        return 0
+
+    print(
+        f"flush plan for {n_sets} sets across {len(subs)} submissions "
+        f"({'+'.join(record['kinds'])}), overhead "
+        f"{planner.overhead_lanes} lanes/extra sub-batch:"
+    )
+    lb, lk, lm = plan.legacy_rung
+    print(
+        f"  mode: {plan.mode}   "
+        f"(legacy single rung B={lb} K={lk} M={lm} -> "
+        f"{plan.legacy_padded} padded lanes, "
+        f"waste {record['legacy_padding_waste']})"
+    )
+    for i, sb in enumerate(plan.sub_batches):
+        b, k, m = sb.rung
+        cold = "  COLD (sheds to CPU fallback, rung demand-paged)" if sb.cold else ""
+        print(
+            f"  {i + 1}. kind={sb.kinds:<24} n={sb.n_sets:>4} "
+            f"k={sb.k_req:>3} m={sb.m_req:>2} -> rung B={b} K={k} M={m}  "
+            f"live {sb.live:>6}  padded {sb.padded:>6}  "
+            f"waste {sb.waste():.4f}{cold}"
+        )
+    print(
+        f"  total: live {plan.live} / padded {plan.padded} lanes, "
+        f"padding_waste {plan.waste():.4f}"
+        + (
+            f"  (saves {plan.legacy_padded - plan.padded} lanes vs legacy)"
+            if plan.mode == "planned"
+            else ""
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
