@@ -402,6 +402,80 @@ def measure_planner_leg(sets, B, K, M, reps: int = 3):
     }
 
 
+def measure_replay_leg(
+    use_cpu: bool,
+    generator: str = "epoch_boundary_flood",
+    seed: int = 7,
+    duration_s: float = 8.0,
+    time_scale: float = 0.5,
+    deadline_ms: float = 50.0,
+) -> dict:
+    """Mainnet-shaped traffic replay (ISSUE 7): per-kind p50/p99 verdict
+    latency and deadline-miss ratio under the epoch-boundary attestation
+    flood, measured through the REAL scheduler stack — the arrival-model
+    counterpart of every steady-state leg above, and the standing
+    acceptance surface for roadmap items 1-3 (docs/TRAFFIC_REPLAY.md).
+    Runs ``tools/traffic_replay.py`` in a SUBPROCESS (crash/wedge costs
+    a marker, never the bench line) against the cpu-native backend —
+    real crypto, no XLA compiles, so the leg measures SCHEDULING latency
+    at a budget the driver can afford; the report records which backend
+    actually ran (a stub fallback can never masquerade as measured
+    crypto)."""
+    replay = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools",
+        "traffic_replay.py",
+    )
+    leg_timeout = min(300.0, _budget_left() - 60)
+    if leg_timeout < 60:
+        return {"skipped": "budget"}
+    env = dict(os.environ)
+    if use_cpu:
+        env["JAX_PLATFORMS"] = "cpu"
+    try:
+        r = subprocess.run(
+            [sys.executable, replay,
+             "--generate", generator, "--seed", str(seed),
+             "--duration", str(duration_s),
+             "--time-scale", str(time_scale),
+             "--deadline-ms", str(deadline_ms),
+             "--verify", "native", "--json"],
+            capture_output=True, text=True, timeout=leg_timeout, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return {"skipped": f"timeout>{leg_timeout:.0f}s"}
+    if r.returncode != 0:
+        return {"error": f"rc={r.returncode}: {r.stderr[-200:]}"}
+    try:
+        report = json.loads(r.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return {"error": f"unparseable output: {r.stdout[-200:]}"}
+    slo = report["slo"]
+    return {
+        "generator": generator,
+        "seed": seed,
+        "n_events": report["n_events"],
+        "n_sets": report["n_sets"],
+        "time_scale": time_scale,
+        "deadline_ms": slo["deadline_ms"],
+        "verify_backend": report["config"]["verify_backend"],
+        "wall_s": report["wall_s"],
+        "arrival_fidelity": report["arrival_fidelity"],
+        "dispatch_lag_p99_ms": report["dispatch_lag_ms"]["p99"],
+        "deadline_misses_total": slo["deadline_misses_total"],
+        "per_kind": {
+            kind: {
+                "count": rec["count_total"],
+                "p50_ms": rec["p50_ms"],
+                "p99_ms": rec["p99_ms"],
+                "miss_ratio": rec["window_miss_ratio"],
+                "paths": {p: v["count"] for p, v in rec["paths"].items()},
+            }
+            for kind, rec in slo["kinds"].items()
+        },
+        "plans": report["scheduler"]["planner"],
+    }
+
+
 def measure_startup_leg(use_cpu: bool, probe_rung: str = "4:1:1") -> dict:
     """Cold-vs-warm node startup (ISSUE 5): the 120.7 s warmup problem
     (BENCH_r05) measured as a trajectory metric. Two ``tools/warmup.py``
@@ -597,6 +671,17 @@ def main() -> None:
         except Exception as e:  # the leg must not kill the line
             planner_leg = {"error": str(e)[:200]}
 
+    # Mainnet-shaped replay (ISSUE 7): per-class p50/p99 verdict latency
+    # under the epoch-boundary flood — the arrival model the SLO layer
+    # certifies, folded into the trajectory. Subprocess, budget-guarded.
+    if _budget_left() < 180:
+        replay_leg = {"skipped": "budget"}
+    else:
+        try:
+            replay_leg = measure_replay_leg(use_cpu)
+        except Exception as e:  # the leg must not kill the line
+            replay_leg = {"error": str(e)[:200]}
+
     # Cold-vs-warm startup (ISSUE 5): two warmup subprocesses against one
     # persistent-cache dir — the trajectory finally records the 120 s
     # first-compile problem AND whether the cache removes it on restart.
@@ -679,6 +764,7 @@ def main() -> None:
                 "stage_latency": headline.get("stage_latency", {}),
                 "scheduler_leg": scheduler_leg,
                 "planner_leg": planner_leg,
+                "replay_leg": replay_leg,
                 "startup": startup,
                 "buckets": buckets,
             }

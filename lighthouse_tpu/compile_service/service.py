@@ -116,6 +116,13 @@ _COLD_ROUTES = metrics.counter_vec(
     "fallback while the rung compiles in the background",
     ("action",),
 )
+_FALLBACK_SECONDS = metrics.histogram(
+    "compile_service_fallback_verify_seconds",
+    "wall time of one synchronous CPU fallback verify of a shed flush — "
+    "the latency a submission pays on the SLO layer's `fallback` "
+    "resolution path (verification_scheduler_verdict_latency_seconds"
+    "{path=fallback}) while the cold rung compiles behind it",
+)
 
 
 def _env_rungs() -> Optional[Tuple[Rung, ...]]:
@@ -441,7 +448,9 @@ class CompileService:
         backend's infinity pre-screens, and exceptions PROPAGATE like the
         direct call's would (the scheduler's bisection delivers them to
         exactly the leaf submission that caused them)."""
-        with tracing.span("compile_service.fallback_verify", n_sets=len(sets)):
+        with tracing.span(
+            "compile_service.fallback_verify", n_sets=len(sets)
+        ), _FALLBACK_SECONDS.time():
             if self._fallback_fn is not None:
                 return bool(self._fallback_fn(list(sets)))
             from ..crypto import bls as _bls

@@ -490,26 +490,49 @@ def stage_latency_summary(impl: str | None = None) -> dict:
     stage; with ``impl=None`` every engine is reported, keyed
     ``stage:fp_impl`` so one engine cannot shadow another. Quantiles are
     histogram-bucket upper bounds (None = beyond the top bucket); count
-    says how many dispatches (compiles included) each row aggregates."""
+    says how many dispatches (compiles included) each row aggregates.
+
+    Also reports the END-TO-END ``bls_device_verify_seconds`` rows
+    (keyed ``verify:<path>``): the verdict-latency SLO layer
+    (docs/TRAFFIC_REPLAY.md) attributes a deadline miss by holding the
+    scheduler's submit-to-verdict tail against this device-side
+    pack+dispatch tail — if the device p99 explains the miss, the fix
+    is batch shape/compile warmth, not queueing."""
     import math
 
     def _finite(q):
         return q if math.isfinite(q) else None  # keep the JSON strict
 
+    def _row(child, child_impl):
+        total, sum_, _cum = child.snapshot()
+        if not total:
+            return None
+        return {
+            "fp_impl": child_impl,
+            "p50_s": _finite(child.quantile(0.5)),
+            "p99_s": _finite(child.quantile(0.99)),
+            "mean_s": round(sum_ / total, 4),
+            "count": total,
+        }
+
     out = {}
     for (stage, child_impl), child in sorted(_STAGE_SECONDS.children().items()):
         if impl is not None and child_impl != impl:
             continue
-        total, sum_, _cum = child.snapshot()
-        if total:
-            key = stage if impl is not None else f"{stage}:{child_impl}"
-            out[key] = {
-                "fp_impl": child_impl,
-                "p50_s": _finite(child.quantile(0.5)),
-                "p99_s": _finite(child.quantile(0.99)),
-                "mean_s": round(sum_ / total, 4),
-                "count": total,
-            }
+        row = _row(child, child_impl)
+        if row:
+            out[stage if impl is not None else f"{stage}:{child_impl}"] = row
+    for (path, child_impl), child in sorted(_VERIFY_SECONDS.children().items()):
+        if impl is not None and child_impl != impl:
+            continue
+        row = _row(child, child_impl)
+        if row:
+            key = (
+                f"verify:{path}"
+                if impl is not None
+                else f"verify:{path}:{child_impl}"
+            )
+            out[key] = row
     return out
 
 

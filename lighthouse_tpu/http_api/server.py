@@ -416,6 +416,13 @@ class BeaconApiServer:
             doc["verification_scheduler"] = (
                 None if sched is None else sched.status()
             )
+            # verdict-latency SLO: rolling p50/p99 + deadline-miss ratio
+            # per caller kind over the scheduler's sample window (null
+            # when the chain runs without a scheduler) — the page that
+            # answers "what are submitters experiencing right now",
+            # certified offline by tools/traffic_replay.py
+            # (docs/TRAFFIC_REPLAY.md)
+            doc["slo"] = None if sched is None else sched.slo_summary()
             # AOT compile service: warm-shape surface, compile queue and
             # persistent-cache state (null when the node runs without one)
             csvc = getattr(chain, "compile_service", None)

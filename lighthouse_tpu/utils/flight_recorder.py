@@ -62,6 +62,7 @@ EVENT_KINDS = (
     "compile_failed",         # compile_service/service.py, per failed rung
     "compile_ready",          # compile_service/service.py, rung now warm
     "compile_started",        # compile_service/service.py, per AOT rung
+    "deadline_miss",          # verification_service/batcher.py, SLO miss
     "log",                    # utils/logging.py, warn/error/crit lines
     "peer_ban",               # network/peer_manager.py
     "peer_penalty",           # network/peer_manager.py

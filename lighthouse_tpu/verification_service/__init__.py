@@ -28,12 +28,14 @@ from .planner import (
     padding_waste_ratio,
     set_geometry,
 )
+from .slo import SloTracker
 
 __all__ = [
     "BUCKET_LADDER",
     "FlushPlan",
     "FlushPlanner",
     "PlannedSubBatch",
+    "SloTracker",
     "VerificationScheduler",
     "backend_verify",
     "backend_verify_each",

@@ -45,6 +45,34 @@ without bound.
 Latency-critical callers (block verification) use :meth:`verify_now`,
 a counted synchronous bypass that never waits on a deadline.
 
+Verdict-latency SLO (ISSUE 7): every submission's end-to-end
+submit→future-resolution latency is measured on EVERY resolution path —
+``fused`` (single-rung flush), ``sub_batch`` (planned split), ``bisection``
+(split-and-retry leaf), ``shed`` (backpressure fallback in the caller's
+thread), ``bypass`` (``verify_now``), ``fallback`` (compile-service
+CPU-native shed), ``empty`` (degenerate immediate False) — into
+``verification_scheduler_verdict_latency_seconds{kind,path}``, so tail
+numbers cannot be flattered by dropping the slow paths. A verdict that
+lands after ``deadline_ms`` (measured from SUBMISSION time, regardless
+of which flush trigger fired — the deadline used to be only a flush
+trigger, so a flush whose device time blew the budget was invisible)
+ticks ``verification_scheduler_deadline_misses_total{kind}`` and
+journals a ``deadline_miss`` event. A rolling per-kind window
+(:mod:`.slo`) serves p50/p99 and miss ratio to ``/lighthouse/health``'s
+``slo`` block and to the traffic-replay harness
+(docs/TRAFFIC_REPLAY.md).
+
+The miss threshold is ``slo_grace * deadline_ms`` (default 2x,
+``LIGHTHOUSE_TPU_SCHED_SLO_GRACE``), NOT ``deadline_ms`` itself: the
+deadline is the maximum queue wait by construction — the trigger fires
+exactly when the oldest submission has waited that long — so a literal
+``latency > deadline`` threshold would brand the oldest member of every
+deadline-triggered flush a miss on trigger-timing noise alone (trickle
+traffic would read 100% miss with an instant backend). With the 2x
+budget, the oldest member of a deadline flush misses exactly when the
+BACKEND took longer than the deadline — the invisible case the SLO
+layer exists to expose.
+
 Flush planning (ISSUE 6): a flush is no longer padded wholesale onto
 one ladder rung. The shape-aware planner (:mod:`.planner`) partitions
 the fused submission list into kind-homogeneous, B-axis bin-packed
@@ -78,6 +106,7 @@ from typing import Callable, List, Optional, Sequence
 
 from ..crypto import bls
 from ..utils import flight_recorder, metrics, tracing
+from .slo import SloTracker
 
 # Mirrors crypto/device/bls._round_up's choices without importing the
 # device stack (jax) here; tests/test_verification_scheduler.py pins the
@@ -200,6 +229,27 @@ _PLAN_LANES = metrics.counter_vec(
     "fallback are not counted — the device paid nothing for them",
     ("lane",),
 )
+_VERDICT_LATENCY = metrics.histogram_vec(
+    "verification_scheduler_verdict_latency_seconds",
+    "end-to-end submit-to-verdict latency per submission, on EVERY "
+    "resolution path: fused (single-rung flush), sub_batch (planned "
+    "split), bisection (split-and-retry leaf), shed (backpressure "
+    "caller-thread fallback), bypass (verify_now), fallback "
+    "(compile-service CPU-native shed), empty (immediate False) — the "
+    "submitter-experienced latency the SLO layer certifies "
+    "(docs/TRAFFIC_REPLAY.md)",
+    ("kind", "path"),
+)
+_DEADLINE_MISSES = metrics.counter_vec(
+    "verification_scheduler_deadline_misses_total",
+    "submissions whose verdict landed after the SLO budget (slo_grace x "
+    "deadline_ms, default 2x — queue-wait allowance plus equal service "
+    "headroom) measured from SUBMISSION time, regardless of which flush "
+    "trigger fired; each miss journals a deadline_miss flight-recorder "
+    "event. The deadline alone is the flush TRIGGER; this family is "
+    "what makes it an SLO",
+    ("kind",),
+)
 
 
 class _Submission:
@@ -227,6 +277,7 @@ class VerificationScheduler:
         compile_service=None,
         plan_flushes: bool | None = None,
         flush_planner=None,
+        slo_grace: float | None = None,
     ):
         self._verify = verify_fn or bls.verify_signature_sets
         # warm-shape router (compile_service/service.py); None = every
@@ -259,6 +310,15 @@ class VerificationScheduler:
             if max_queue_sets is not None
             else _env_int("LIGHTHOUSE_TPU_SCHED_MAX_QUEUE", 2048)
         )
+        # verdict-SLO budget multiplier (see module docstring: deadline
+        # = max queue wait by construction, so the budget adds service
+        # headroom; <1x would brand trigger noise a miss)
+        self.slo_grace = max(
+            1.0,
+            slo_grace
+            if slo_grace is not None
+            else _env_float("LIGHTHOUSE_TPU_SCHED_SLO_GRACE", 2.0),
+        )
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._pending: deque[_Submission] = deque()
@@ -276,6 +336,11 @@ class VerificationScheduler:
         self._plans_planned = 0
         self._plans_single = 0
         self._last_plan: Optional[dict] = None
+        # rolling verdict-latency window (the /lighthouse/health slo
+        # block and the replay harness read THIS scheduler's window, not
+        # the process-global cumulative histograms); the tracker also
+        # owns the lifetime miss totals — one source of truth
+        self._slo = SloTracker()
 
     # -- lifecycle --------------------------------------------------------
 
@@ -318,7 +383,7 @@ class VerificationScheduler:
         if not sub.sets:
             # matches verify_signature_sets([]) == False; must not join a
             # fused batch where it would have no sets to vote with
-            self._finish(sub, False)
+            self._finish(sub, False, path="empty")
             return sub.future
         shed = False
         with self._cv:
@@ -362,6 +427,7 @@ class VerificationScheduler:
                 # applies HERE too — a backpressure shed must not block a
                 # gossip caller on an XLA compile either.
                 verify = None
+                path = "shed"
                 svc = self._compile_service
                 if svc is not None and svc.active():
                     decision = svc.decide_flush(
@@ -369,7 +435,8 @@ class VerificationScheduler:
                     )
                     if decision["action"] == "shed":
                         verify = svc.fallback_verify
-                self._resolve_group([sub], verify)
+                        path = "fallback"
+                self._resolve_group([sub], verify, path=path)
         return sub.future
 
     def verify_now(self, sets, kind: str = "block") -> bool:
@@ -378,16 +445,37 @@ class VerificationScheduler:
         traffic skips the fusing queue."""
         sets = list(sets)
         _BYPASS.with_labels(kind).inc()
-        with tracing.span("scheduler.bypass", kind=kind, n_sets=len(sets)):
-            svc = self._compile_service
-            if svc is not None and svc.active():
-                # even the latency-critical bypass must not stall on a
-                # cold-bucket XLA compile: shed to the service's counted
-                # synchronous fallback (identical verdict)
-                decision = svc.decide_flush(sets, caller=f"verify_now:{kind}")
-                if decision["action"] == "shed":
-                    return svc.fallback_verify(sets)
-            return self._verify(sets)
+        t0 = time.monotonic()
+        path = "bypass"
+        try:
+            with tracing.span("scheduler.bypass", kind=kind, n_sets=len(sets)):
+                svc = self._compile_service
+                if svc is not None and svc.active():
+                    # even the latency-critical bypass must not stall on a
+                    # cold-bucket XLA compile: shed to the service's counted
+                    # synchronous fallback (identical verdict)
+                    decision = svc.decide_flush(
+                        sets, caller=f"verify_now:{kind}"
+                    )
+                    if decision["action"] == "shed":
+                        # SLO path follows the RESOLUTION, not the entry:
+                        # a bypass served by the CPU fallback has the
+                        # fallback's latency profile, and filing it under
+                        # `bypass` would blame device dispatch for a
+                        # cold-route cost (the other fallback call sites
+                        # already label it this way)
+                        path = "fallback"
+                        return svc.fallback_verify(sets)
+                return self._verify(sets)
+        finally:
+            # the bypass IS this caller's end-to-end latency: no queue,
+            # but a cold-route fallback or a slow device dispatch can
+            # still blow the deadline — it must feed the same SLO
+            # surface the queued paths do (a raise still observes; the
+            # caller paid the wall time either way)
+            self._observe_latency(
+                kind, path, time.monotonic() - t0, len(sets)
+            )
 
     def flush(self) -> None:
         """Ask the flush thread to dispatch whatever is pending now."""
@@ -528,6 +616,16 @@ class VerificationScheduler:
                     dev_padded += paid
                 self._fused_batches += 1
                 self._buckets_seen.add(sb.rung[0])
+                # SLO path label: the compile-service CPU fallback is its
+                # own resolution path (its latency profile is nothing
+                # like a device dispatch); otherwise a planned split
+                # resolves via sub_batch, a single-rung flush via fused
+                if route_action == "shed":
+                    path = "fallback"
+                elif plan.mode == "planned":
+                    path = "sub_batch"
+                else:
+                    path = "fused"
                 with tracing.span(
                     "scheduler.sub_batch",
                     kinds=sb.kinds,
@@ -535,7 +633,9 @@ class VerificationScheduler:
                     rung="x".join(str(v) for v in sb.rung),
                     route=route_action,
                 ):
-                    ok = self._resolve_group(sb.subs, verify, fused=sb.sets)
+                    ok = self._resolve_group(
+                        sb.subs, verify, fused=sb.sets, path=path
+                    )
                 all_ok = all_ok and ok
             sp.set(verdict=all_ok)
         if dev_padded:
@@ -580,7 +680,7 @@ class VerificationScheduler:
 
     def _resolve_group(
         self, subs: List[_Submission], verify: Optional[Callable] = None,
-        fused: Optional[list] = None,
+        fused: Optional[list] = None, path: str = "fused",
     ) -> bool:
         """Verify ``subs`` as one fused call; on False — or on a raised
         backend exception, which a larger fused shape can hit even when
@@ -589,7 +689,10 @@ class VerificationScheduler:
         produces. Only a LEAF failure is delivered to a future.
         ``verify`` overrides the backend for the WHOLE resolution tree
         (the compile service's shed fallback); ``fused`` is the caller's
-        already-flattened set list (bisection sub-calls re-flatten)."""
+        already-flattened set list (bisection sub-calls re-flatten);
+        ``path`` is the SLO resolution-path label every member resolves
+        under (a bisected tree relabels its members ``bisection`` — the
+        retries ARE the latency the submitter experienced)."""
         if verify is None:
             verify = self._verify
         try:
@@ -601,19 +704,21 @@ class VerificationScheduler:
             if len(subs) == 1:
                 sub = subs[0]
                 # this fused call WAS the direct call: the caller would
-                # have seen the raise, so the future carries it
+                # have seen the raise, so the future carries it (and the
+                # wall time it waited still counts against the SLO)
                 _SUBMISSIONS.with_labels(sub.kind, "error").inc()
+                self._account(sub, path)
                 if not sub.future.done():
                     sub.future.set_exception(e)
                 return False
             return self._bisect(subs, verify)
         if ok:
             for s in subs:
-                self._finish(s, True)
+                self._finish(s, True, path)
             return True
         if len(subs) == 1:
             # leaf: this fused call WAS the direct per-caller call
-            self._finish(subs[0], False)
+            self._finish(subs[0], False, path)
             return False
         return self._bisect(subs, verify)
 
@@ -629,14 +734,61 @@ class VerificationScheduler:
             kinds="+".join(sorted({s.kind for s in subs})),
         )
         mid = len(subs) // 2
-        left = self._resolve_group(subs[:mid], verify)
-        right = self._resolve_group(subs[mid:], verify)
+        left = self._resolve_group(subs[:mid], verify, path="bisection")
+        right = self._resolve_group(subs[mid:], verify, path="bisection")
         return left and right
 
-    def _finish(self, sub: _Submission, ok: bool) -> None:
+    def _finish(self, sub: _Submission, ok: bool, path: str) -> None:
+        # accounting is unconditional — the resolution tree reaches each
+        # submission exactly once, and an externally-cancelled future
+        # must not make the counters (or the SLO window) undercount the
+        # work the scheduler actually did; only the future mutation is
+        # guarded
+        self._account(sub, path)
         _SUBMISSIONS.with_labels(sub.kind, "ok" if ok else "invalid").inc()
         if not sub.future.done():
             sub.future.set_result(ok)
+
+    # -- verdict-latency SLO ----------------------------------------------
+
+    def _account(self, sub: _Submission, path: str) -> None:
+        """One submission resolved: its end-to-end latency feeds the SLO
+        surface exactly once, on whatever path delivered the verdict."""
+        self._observe_latency(
+            sub.kind, path, time.monotonic() - sub.submitted_at,
+            len(sub.sets),
+        )
+
+    def _observe_latency(
+        self, kind: str, path: str, latency_s: float, n_sets: int
+    ) -> None:
+        budget_s = self.deadline_s * self.slo_grace
+        missed = latency_s > budget_s
+        _VERDICT_LATENCY.with_labels(kind, path).observe(latency_s)
+        self._slo.observe(kind, path, latency_s, missed)
+        if missed:
+            _DEADLINE_MISSES.with_labels(kind).inc()
+            flight_recorder.record(
+                "deadline_miss",
+                kind=kind,
+                path=path,
+                n_sets=n_sets,
+                latency_ms=round(latency_s * 1000.0, 3),
+                deadline_ms=round(self.deadline_s * 1000.0, 3),
+                budget_ms=round(budget_s * 1000.0, 3),
+            )
+
+    def slo_summary(self) -> dict:
+        """Rolling p50/p99 + miss ratio per kind over the tracker window
+        — the ``slo`` block `/lighthouse/health` serves and the replay
+        harness reports (docs/TRAFFIC_REPLAY.md)."""
+        doc = self._slo.summary(deadline_ms=self.deadline_s * 1000.0)
+        doc["slo_grace"] = self.slo_grace
+        doc["budget_ms"] = round(
+            self.deadline_s * self.slo_grace * 1000.0, 3
+        )
+        doc["deadline_misses_total"] = self._slo.misses_total()
+        return doc
 
     # -- introspection ----------------------------------------------------
 
@@ -651,6 +803,7 @@ class VerificationScheduler:
             "running": self.running(),
             "queue_submissions": pending_subs,
             "queue_sets": pending_sets,
+            "deadline_misses_total": self._slo.misses_total(),
             "max_batch_sets": self.max_batch_sets,
             "max_queue_sets": self.max_queue_sets,
             "deadline_ms": round(self.deadline_s * 1000.0, 3),

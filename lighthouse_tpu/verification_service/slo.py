@@ -1,0 +1,139 @@
+"""Rolling verdict-latency SLO tracker for the verification scheduler.
+
+The Prometheus histogram family
+(``verification_scheduler_verdict_latency_seconds{kind,path}``,
+batcher.py) is cumulative — right for dashboards, wrong for the question
+an operator asks ``/lighthouse/health``: "what are submitters
+experiencing RIGHT NOW?". This module keeps a bounded per-kind window of
+the most recent end-to-end submit→verdict latencies (every resolution
+path: fused flush, planned sub-batch, bisection retry, backpressure
+shed, ``verify_now`` bypass, compile-service fallback) and answers with
+rolling p50/p99 and the deadline-miss ratio over that window — the
+``slo`` block the health endpoint serves and the traffic-replay harness
+(``tools/traffic_replay.py``, docs/TRAFFIC_REPLAY.md) certifies against.
+
+Deliberately **jax-free** and scheduler-instance-scoped: a replay run or
+a test reads ITS scheduler's window, not the process-global metric
+registry another run already polluted.
+
+Design constraints (same discipline as the metric families):
+
+* ``observe()`` is O(1): one deque append under one lock — it sits on
+  every future resolution, including the shed path that runs in a
+  gossip caller's thread.
+* ``summary()`` sorts only the bounded window (default 1024 samples per
+  kind, ``LIGHTHOUSE_TPU_SLO_WINDOW``) — a health scrape can never walk
+  unbounded history.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+from collections import deque
+from typing import Deque, Dict, Tuple
+
+DEFAULT_WINDOW = 1024
+_ENV_WINDOW = "LIGHTHOUSE_TPU_SLO_WINDOW"
+
+# (latency_seconds, path, missed)
+_Sample = Tuple[float, str, bool]
+
+
+def quantile_ms(sorted_latencies, q: float) -> float:
+    """Nearest-rank quantile of an already-sorted seconds list, in
+    milliseconds (0.0 for an empty window). THE quantile spelling for
+    every replay/SLO report (tools/traffic_replay.py reuses it for
+    dispatch-lag), so the harness and the health block can never
+    disagree on rank semantics. Nearest-rank proper: index
+    ``ceil(q*n) - 1`` — ``int(q*n)`` would overshoot by one exactly when
+    ``q*n`` is integral, silently reporting the max as p99 at round
+    window sizes."""
+    if not sorted_latencies:
+        return 0.0
+    n = len(sorted_latencies)
+    idx = min(n - 1, max(0, math.ceil(q * n) - 1))
+    return round(sorted_latencies[idx] * 1000.0, 3)
+
+
+class SloTracker:
+    """Bounded rolling window of verdict latencies per caller kind (see
+    module docstring). ``observe`` is called by the scheduler on every
+    resolution; ``summary`` is the health-endpoint/replay-report read."""
+
+    def __init__(self, window: int | None = None):
+        if window is None:
+            try:
+                window = int(os.environ.get(_ENV_WINDOW, ""))
+            except ValueError:
+                window = DEFAULT_WINDOW
+        self.window = max(1, int(window))
+        self._lock = threading.Lock()
+        self._samples: Dict[str, Deque[_Sample]] = {}
+        self._count_total: Dict[str, int] = {}
+        self._misses_total: Dict[str, int] = {}
+
+    def observe(
+        self, kind: str, path: str, seconds: float, missed: bool
+    ) -> None:
+        """Record one resolved submission: end-to-end latency, the
+        resolution path that produced the verdict, and whether it landed
+        past the deadline."""
+        with self._lock:
+            dq = self._samples.get(kind)
+            if dq is None:
+                dq = self._samples[kind] = deque(maxlen=self.window)
+                self._count_total[kind] = 0
+                self._misses_total[kind] = 0
+            dq.append((seconds, path, missed))
+            self._count_total[kind] += 1
+            if missed:
+                self._misses_total[kind] += 1
+
+    def misses_total(self) -> int:
+        """Lifetime deadline misses across every kind — THE total the
+        scheduler's ``status()`` and ``slo_summary()`` both report (one
+        source of truth; the per-kind split lives in ``summary()``)."""
+        with self._lock:
+            return sum(self._misses_total.values())
+
+    def summary(self, deadline_ms: float | None = None) -> dict:
+        """The ``slo`` document: per kind, rolling p50/p99/max over the
+        window, window miss ratio, lifetime totals, and a per-path
+        breakdown (each path's own window quantiles), so a flattering
+        fast path cannot hide a slow one's tail."""
+        with self._lock:
+            snap = {k: list(dq) for k, dq in self._samples.items()}
+            counts = dict(self._count_total)
+            misses = dict(self._misses_total)
+        kinds = {}
+        for kind in sorted(snap):
+            samples = snap[kind]
+            lat = sorted(s[0] for s in samples)
+            window_misses = sum(1 for s in samples if s[2])
+            paths = {}
+            for path in sorted({s[1] for s in samples}):
+                plat = sorted(s[0] for s in samples if s[1] == path)
+                paths[path] = {
+                    "count": len(plat),
+                    "p50_ms": quantile_ms(plat, 0.50),
+                    "p99_ms": quantile_ms(plat, 0.99),
+                }
+            kinds[kind] = {
+                "count_total": counts[kind],
+                "window_count": len(samples),
+                "p50_ms": quantile_ms(lat, 0.50),
+                "p99_ms": quantile_ms(lat, 0.99),
+                "max_ms": round(lat[-1] * 1000.0, 3) if lat else 0.0,
+                "misses_total": misses[kind],
+                "window_misses": window_misses,
+                "window_miss_ratio": (
+                    round(window_misses / len(samples), 4) if samples else 0.0
+                ),
+                "paths": paths,
+            }
+        doc = {"window": self.window, "kinds": kinds}
+        if deadline_ms is not None:
+            doc["deadline_ms"] = round(float(deadline_ms), 3)
+        return doc

@@ -1,0 +1,482 @@
+"""Arrival-trace model for the traffic-replay harness (ISSUE 7).
+
+"Heavy traffic from millions of users" needs an ARRIVAL model, not just
+a steady-state throughput number (ROADMAP item 5): every bench so far
+measures one fixed shape at saturation, which says nothing about what a
+submitter experiences under bursty, epoch-boundary-shaped load. This
+module is the jax-free substrate of that model — shared by the replay
+driver (``tools/traffic_replay.py``), the bench ``replay_leg``, and the
+determinism tests:
+
+* a **versioned JSONL trace format** (:data:`TRACE_SCHEMA`): one header
+  line, then one arrival event per line — ``t`` (seconds from trace
+  start), ``kind`` (caller kind), ``n_sets``/``pubkeys``/``messages``
+  (submission geometry, the three axes the packers pad), ``path``
+  (``submit`` for the fusing queue, ``verify_now`` for the
+  latency-critical bypass);
+* **synthetic mainnet-shaped generators** (:data:`GENERATORS`):
+  gossip steady-state, epoch-boundary attestation flood, sync-committee
+  period, bulk backfill running underneath — each fully deterministic
+  under its seed (``random.Random``; no wall clock, no global RNG);
+* a **lockstep simulator** (:func:`lockstep_replay`): the scheduler's
+  drain/flush policy and the shape-aware planner replayed as a pure
+  function of the trace — same trace + same seed ⇒ identical submission
+  sequence, flush-plan shapes and set counts, byte for byte (the
+  determinism gate ``tests/test_traffic_replay.py`` pins this in a
+  subprocess, like ``tools/flush_plan_report.py``). The timed replay
+  against a LIVE scheduler+compile-service stack lives in
+  ``tools/traffic_replay.py``; this module never starts a thread.
+
+Trace schema and generator catalogue are documented in
+``docs/TRAFFIC_REPLAY.md`` (linted by ``tests/test_zgate4_metrics_lint``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import random
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .planner import FlushPlanner
+
+TRACE_VERSION = 1
+TRACE_SCHEMA = f"lighthouse_tpu.traffic_trace/{TRACE_VERSION}"
+
+_PATHS = ("submit", "verify_now")
+_EVENT_DEFAULTS = {"pubkeys": 1, "messages": 1, "path": "submit"}
+
+
+# ---------------------------------------------------------------------------
+# Trace format (JSONL: header line + one event per line)
+# ---------------------------------------------------------------------------
+
+
+def _validate_event(ev: dict, lineno: int) -> dict:
+    out = dict(_EVENT_DEFAULTS)
+    out.update(ev)
+    try:
+        out["t"] = float(out["t"])
+        out["kind"] = str(out["kind"])
+        out["n_sets"] = int(out["n_sets"])
+        out["pubkeys"] = int(out["pubkeys"])
+        out["messages"] = int(out["messages"])
+    except (KeyError, TypeError, ValueError) as e:
+        raise ValueError(f"trace line {lineno}: malformed event {ev!r}: {e}")
+    if out["t"] < 0 or out["n_sets"] <= 0 or out["pubkeys"] <= 0 \
+            or out["messages"] <= 0:
+        raise ValueError(
+            f"trace line {lineno}: non-positive geometry/time in {ev!r}"
+        )
+    if out["path"] not in _PATHS:
+        raise ValueError(
+            f"trace line {lineno}: unknown path {out['path']!r} "
+            f"(expected one of {_PATHS})"
+        )
+    return out
+
+
+def trace_header(
+    events: List[dict],
+    name: str,
+    seed: int,
+    generator: str | None = None,
+    params: dict | None = None,
+) -> dict:
+    """THE header document for a trace of ``events`` (assumed sorted) —
+    one construction shared by :func:`write_trace` and the replay
+    driver's generate-without-write path, so the two can never carry
+    different field sets."""
+    return {
+        "schema": TRACE_SCHEMA,
+        "name": name,
+        "seed": int(seed),
+        "n_events": len(events),
+        "duration_s": round(events[-1]["t"], 6) if events else 0.0,
+        "generator": generator,
+        "params": params or {},
+    }
+
+
+def write_trace(
+    path: str,
+    events: List[dict],
+    name: str,
+    seed: int,
+    generator: str | None = None,
+    params: dict | None = None,
+) -> dict:
+    """Write ``events`` as a versioned JSONL trace; returns the header.
+    Events are validated and written sorted by arrival time so a trace
+    file is replayable as-is."""
+    events = sorted(
+        (_validate_event(ev, i + 2) for i, ev in enumerate(events)),
+        key=lambda e: e["t"],
+    )
+    header = trace_header(events, name, seed, generator, params)
+    with open(path, "w") as f:
+        f.write(json.dumps(header) + "\n")
+        for ev in events:
+            f.write(json.dumps(ev, sort_keys=True) + "\n")
+    return header
+
+
+def read_trace(path: str) -> Tuple[dict, List[dict]]:
+    """Parse a trace file; raises ``ValueError`` on a missing/unsupported
+    schema version or a malformed event — a replay must never silently
+    reinterpret a trace written by a different format generation."""
+    with open(path) as f:
+        # keep REAL file line numbers through the blank-line filter so
+        # every error message points at the line the operator must edit
+        lines = [
+            (lineno, ln)
+            for lineno, ln in enumerate((l.strip() for l in f), start=1)
+            if ln
+        ]
+    if not lines:
+        raise ValueError(f"{path}: empty trace file")
+    header_lineno, header_line = lines[0]
+    try:
+        header = json.loads(header_line)
+    except ValueError as e:
+        raise ValueError(
+            f"{path}: line {header_lineno}: unparseable header: {e}"
+        )
+    schema = header.get("schema")
+    if schema != TRACE_SCHEMA:
+        raise ValueError(
+            f"{path}: unsupported trace schema {schema!r} "
+            f"(this build reads {TRACE_SCHEMA!r})"
+        )
+    events = []
+    for lineno, ln in lines[1:]:
+        try:
+            ev = json.loads(ln)
+        except ValueError as e:
+            raise ValueError(f"{path}: line {lineno}: unparseable: {e}")
+        events.append(_validate_event(ev, lineno))
+    events.sort(key=lambda e: e["t"])
+    return header, events
+
+
+def synthetic_sets(
+    kind: str, n_sets: int, pubkeys: int, messages: int
+) -> list:
+    """Geometry-only signature sets for an arrival event: ``(None,
+    [None]*pubkeys, message bytes)`` triples — everything the planner
+    and the packers' geometry extraction read, nothing the crypto needs
+    (same trick as ``tools/flush_plan_report.py``). Messages are salted
+    per kind: real traffic's kinds sign different messages, so the
+    fused flush's unique-message axis is the sum, not the max, of the
+    per-kind counts."""
+    return [
+        (
+            None,
+            [None] * pubkeys,
+            kind.encode() + (i % max(1, messages)).to_bytes(4, "big"),
+        )
+        for i in range(n_sets)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Generators (deterministic under seed; rates are per-second)
+# ---------------------------------------------------------------------------
+
+
+def _poisson(
+    rng: random.Random,
+    rate: float,
+    t0: float,
+    t1: float,
+    make: Callable[[float, random.Random], dict],
+) -> List[dict]:
+    """Homogeneous Poisson arrivals of one event class on [t0, t1)."""
+    out: List[dict] = []
+    if rate <= 0 or t1 <= t0:
+        return out
+    t = t0 + rng.expovariate(rate)
+    while t < t1:
+        out.append(make(round(t, 6), rng))
+        t += rng.expovariate(rate)
+    return out
+
+
+def _finish(events: List[dict]) -> List[dict]:
+    events.sort(key=lambda e: e["t"])
+    return events
+
+
+def gossip_steady(
+    duration_s: float = 10.0,
+    seed: int = 0,
+    rate_scale: float = 1.0,
+    committee: int = 8,
+    unagg_rate: float = 40.0,
+    agg_rate: float = 12.0,
+    sync_rate: float = 6.0,
+) -> List[dict]:
+    """Steady-state gossip: single-pubkey attestations, committee-width
+    aggregates, and sync-committee messages as independent Poisson
+    streams — the baseline every other shape layers onto."""
+    rng = random.Random(seed)
+    evs: List[dict] = []
+    evs += _poisson(
+        rng, unagg_rate * rate_scale, 0.0, duration_s,
+        lambda t, r: {"t": t, "kind": "unaggregated", "n_sets": 1,
+                      "pubkeys": 1, "messages": 1, "path": "submit"},
+    )
+    evs += _poisson(
+        rng, agg_rate * rate_scale, 0.0, duration_s,
+        lambda t, r: {"t": t, "kind": "aggregate", "n_sets": 1,
+                      "pubkeys": committee, "messages": 1, "path": "submit"},
+    )
+    evs += _poisson(
+        rng, sync_rate * rate_scale, 0.0, duration_s,
+        lambda t, r: {"t": t, "kind": "sync_message", "n_sets": 1,
+                      "pubkeys": 1, "messages": 1, "path": "submit"},
+    )
+    return _finish(evs)
+
+
+def epoch_boundary_flood(
+    duration_s: float = 12.0,
+    seed: int = 0,
+    rate_scale: float = 1.0,
+    committee: int = 8,
+    slot_s: float = 2.0,
+    flood_start_frac: float = 0.5,
+    flood_width_s: float = 2.0,
+    flood_factor: float = 8.0,
+    block_sets: int = 2,
+) -> List[dict]:
+    """The acceptance-gate shape: gossip steady-state with an
+    attestation FLOOD in the window starting at
+    ``flood_start_frac * duration_s`` (the epoch boundary, where every
+    validator's attestation for the old epoch and the committee
+    reshuffle land together), plus one latency-critical block
+    verification per slot on the ``verify_now`` bypass — the trace that
+    exercises fused, planned, shed, bypass and fallback resolution
+    paths at once."""
+    rng = random.Random(seed)
+    evs = gossip_steady(
+        duration_s=duration_s, seed=seed + 1, rate_scale=rate_scale,
+        committee=committee,
+    )
+    f0 = flood_start_frac * duration_s
+    f1 = min(duration_s, f0 + flood_width_s)
+    # the flood rides ON TOP of the base rates (extra independent
+    # streams), so the boundary window carries base + (factor-1)x extra
+    extra = max(0.0, flood_factor - 1.0) * rate_scale
+    evs += _poisson(
+        rng, 40.0 * extra, f0, f1,
+        lambda t, r: {"t": t, "kind": "unaggregated", "n_sets": 1,
+                      "pubkeys": 1, "messages": 1, "path": "submit"},
+    )
+    evs += _poisson(
+        rng, 12.0 * extra, f0, f1,
+        lambda t, r: {"t": t, "kind": "aggregate", "n_sets": 1,
+                      "pubkeys": committee, "messages": 1, "path": "submit"},
+    )
+    # one block per slot, early in the slot, on the synchronous bypass
+    slot = 0
+    while slot * slot_s < duration_s:
+        t = slot * slot_s + rng.uniform(0.0, 0.3 * slot_s)
+        if t < duration_s:
+            evs.append({
+                "t": round(t, 6), "kind": "block", "n_sets": block_sets,
+                "pubkeys": 1, "messages": block_sets, "path": "verify_now",
+            })
+        slot += 1
+    return _finish(evs)
+
+
+def sync_committee_period(
+    duration_s: float = 12.0,
+    seed: int = 0,
+    rate_scale: float = 1.0,
+    slot_s: float = 2.0,
+    subcommittee: int = 16,
+    msg_rate: float = 30.0,
+    contrib_per_slot: int = 4,
+    background_rate: float = 8.0,
+) -> List[dict]:
+    """Sync-committee period: per slot, a burst of single-pubkey sync
+    messages in the first half (the 4-second broadcast window scaled
+    down) and a few subcommittee-width contributions near the slot end,
+    over a thin attestation background."""
+    rng = random.Random(seed)
+    evs: List[dict] = []
+    evs += _poisson(
+        rng, background_rate * rate_scale, 0.0, duration_s,
+        lambda t, r: {"t": t, "kind": "unaggregated", "n_sets": 1,
+                      "pubkeys": 1, "messages": 1, "path": "submit"},
+    )
+    slot = 0
+    while slot * slot_s < duration_s:
+        s0 = slot * slot_s
+        evs += _poisson(
+            rng, msg_rate * rate_scale, s0, min(duration_s, s0 + slot_s / 2),
+            lambda t, r: {"t": t, "kind": "sync_message", "n_sets": 1,
+                          "pubkeys": 1, "messages": 1, "path": "submit"},
+        )
+        for _ in range(contrib_per_slot):
+            t = s0 + slot_s * rng.uniform(0.7, 0.95)
+            if t < duration_s:
+                evs.append({
+                    "t": round(t, 6), "kind": "sync_contribution",
+                    "n_sets": 1, "pubkeys": subcommittee, "messages": 1,
+                    "path": "submit",
+                })
+        slot += 1
+    return _finish(evs)
+
+
+def bulk_backfill(
+    duration_s: float = 20.0,
+    seed: int = 0,
+    rate_scale: float = 1.0,
+    committee: int = 8,
+    batch_every_s: float = 2.5,
+    batch_sets: Tuple[int, ...] = (64, 96, 128),
+    gossip_rate: float = 8.0,
+) -> List[dict]:
+    """Chain-segment backfill running UNDERNEATH live gossip: large
+    deadline-insensitive contiguous submissions every few seconds (the
+    ROADMAP item-5 bulk class) while a thin unaggregated stream keeps
+    arriving — the shape that shows whether bulk batches starve gossip
+    tail latency."""
+    rng = random.Random(seed)
+    evs: List[dict] = []
+    evs += _poisson(
+        rng, gossip_rate * rate_scale, 0.0, duration_s,
+        lambda t, r: {"t": t, "kind": "unaggregated", "n_sets": 1,
+                      "pubkeys": 1, "messages": 1, "path": "submit"},
+    )
+    t = rng.uniform(0.0, batch_every_s)
+    while t < duration_s:
+        n = rng.choice(batch_sets)
+        evs.append({
+            "t": round(t, 6), "kind": "backfill", "n_sets": int(n),
+            "pubkeys": committee, "messages": max(1, int(n) // 8),
+            "path": "submit",
+        })
+        t += batch_every_s * rng.uniform(0.7, 1.3)
+    return _finish(evs)
+
+
+# Generator catalogue: every entry documented in docs/TRAFFIC_REPLAY.md
+# (linted by tests/test_zgate4_metrics_lint.py).
+GENERATORS: Dict[str, Callable[..., List[dict]]] = {
+    "gossip_steady": gossip_steady,
+    "epoch_boundary_flood": epoch_boundary_flood,
+    "sync_committee_period": sync_committee_period,
+    "bulk_backfill": bulk_backfill,
+}
+
+
+# ---------------------------------------------------------------------------
+# Lockstep replay: the flush policy as a pure function of the trace
+# ---------------------------------------------------------------------------
+
+
+class ReplaySubmission:
+    """The planner-facing submission shape (``.kind`` + ``.sets``),
+    shared by the lockstep simulator and the timed driver's payload
+    pre-build."""
+
+    __slots__ = ("kind", "sets")
+
+    def __init__(self, kind: str, sets: list):
+        self.kind = kind
+        self.sets = sets
+
+
+def lockstep_replay(
+    events: List[dict],
+    deadline_ms: float = 25.0,
+    max_batch_sets: int = 256,
+    planner: Optional[FlushPlanner] = None,
+    warm_rungs: Optional[list] = None,
+) -> dict:
+    """Deterministic virtual replay: walk the trace in arrival order and
+    apply the scheduler's EXACT drain/flush policy (deadline measured
+    from the oldest pending submission; bucket-full at
+    ``max_batch_sets``; whole-submission drains; shutdown drain at the
+    end) with the shape-aware planner deciding every flush — no
+    threads, no wall clock, no jax. The returned report (submission
+    sequence, per-flush plan shapes, per-kind set counts, and a sha256
+    digest over all of it) is a pure function of (trace, parameters):
+    the determinism property ``tests/test_traffic_replay.py`` pins
+    across processes."""
+    planner = planner or FlushPlanner()
+    deadline_s = deadline_ms / 1000.0
+    pending: deque = deque()  # (ReplaySubmission, arrival t)
+    pending_sets = 0
+    submissions: List[list] = []
+    bypasses: List[list] = []
+    flushes: List[dict] = []
+    set_totals: Dict[str, int] = {}
+
+    def drain_one(trigger: str) -> None:
+        nonlocal pending_sets
+        subs: List[ReplaySubmission] = []
+        n = 0
+        while pending:
+            nxt, _t = pending[0]
+            if subs and n + len(nxt.sets) > max_batch_sets:
+                break
+            sub, _t = pending.popleft()
+            subs.append(sub)
+            n += len(sub.sets)
+        pending_sets -= n
+        plan = planner.plan(subs, warm_rungs=warm_rungs)
+        flushes.append({
+            "trigger": trigger,
+            "n_submissions": len(subs),
+            "n_sets": n,
+            "mode": plan.mode,
+            "rungs": plan.rungs_label(),
+            "live_lanes": plan.live,
+            "padded_lanes": plan.padded,
+            "waste": round(plan.waste(), 4),
+        })
+
+    for ev in sorted(events, key=lambda e: e["t"]):
+        # deadline flushes due BEFORE this arrival (each drain takes one
+        # bucket-worth, then the condition re-evaluates — the loop shape
+        # of VerificationScheduler._loop)
+        while pending and pending[0][1] + deadline_s <= ev["t"]:
+            drain_one("deadline")
+        if ev["path"] == "verify_now":
+            bypasses.append([ev["kind"], ev["n_sets"]])
+            set_totals[ev["kind"]] = (
+                set_totals.get(ev["kind"], 0) + ev["n_sets"]
+            )
+            continue
+        sets = synthetic_sets(
+            ev["kind"], ev["n_sets"], ev["pubkeys"], ev["messages"]
+        )
+        pending.append((ReplaySubmission(ev["kind"], sets), ev["t"]))
+        pending_sets += ev["n_sets"]
+        submissions.append([ev["kind"], ev["n_sets"]])
+        set_totals[ev["kind"]] = set_totals.get(ev["kind"], 0) + ev["n_sets"]
+        while pending_sets >= max_batch_sets:
+            drain_one("full")
+    while pending:
+        drain_one("shutdown")
+
+    body = {
+        "n_events": len(events),
+        "deadline_ms": round(deadline_ms, 3),
+        "max_batch_sets": max_batch_sets,
+        "submissions": submissions,
+        "bypasses": bypasses,
+        "flushes": flushes,
+        "set_totals": dict(sorted(set_totals.items())),
+    }
+    digest = hashlib.sha256(
+        json.dumps(body, sort_keys=True).encode()
+    ).hexdigest()
+    return {"mode": "lockstep", **body, "digest": digest}

@@ -1,0 +1,315 @@
+"""Traffic-replay harness (ISSUE 7): versioned arrival-trace format,
+deterministic mainnet-shaped generators, lockstep replay determinism
+(pinned in a SUBPROCESS, jax-free — the flush-plan-report discipline),
+and the acceptance drive: the epoch-boundary-flood trace replayed
+against a live scheduler stack produces a per-kind SLO report with
+samples on the fused, shed and bypass resolution paths, and an injected
+slow flush lands as counted+journaled deadline misses.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from lighthouse_tpu.utils import flight_recorder as fr
+from lighthouse_tpu.utils import metrics
+from lighthouse_tpu.verification_service import traffic
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+@pytest.fixture
+def recorder(tmp_path):
+    prev = fr.configure(
+        capacity=4096, enabled=True, dump=False, dump_dir=str(tmp_path),
+    )
+    fr.clear()
+    try:
+        yield
+    finally:
+        fr.configure(**prev)
+        fr.clear()
+
+
+# ---------------------------------------------------------------------------
+# Trace format
+# ---------------------------------------------------------------------------
+
+
+def test_trace_roundtrip(tmp_path):
+    events = traffic.GENERATORS["bulk_backfill"](duration_s=5.0, seed=3)
+    path = str(tmp_path / "bf.jsonl")
+    header = traffic.write_trace(
+        path, events, name="bf", seed=3, generator="bulk_backfill"
+    )
+    assert header["schema"] == traffic.TRACE_SCHEMA
+    h2, evs2 = traffic.read_trace(path)
+    assert h2 == header
+    assert len(evs2) == len(events)
+    assert [e["t"] for e in evs2] == sorted(e["t"] for e in events)
+    # every event normalized: full field set, valid path
+    for ev in evs2:
+        assert set(ev) >= {"t", "kind", "n_sets", "pubkeys", "messages",
+                           "path"}
+        assert ev["path"] in ("submit", "verify_now")
+
+
+def test_trace_version_and_malformed_rejected(tmp_path):
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text('{"schema": "lighthouse_tpu.traffic_trace/999"}\n')
+    with pytest.raises(ValueError, match="unsupported trace schema"):
+        traffic.read_trace(str(bad))
+    neg = tmp_path / "neg.jsonl"
+    neg.write_text(
+        json.dumps({"schema": traffic.TRACE_SCHEMA}) + "\n"
+        + json.dumps({"t": -1.0, "kind": "x", "n_sets": 1}) + "\n"
+    )
+    with pytest.raises(ValueError, match="non-positive"):
+        traffic.read_trace(str(neg))
+    weird = tmp_path / "weird.jsonl"
+    weird.write_text(
+        json.dumps({"schema": traffic.TRACE_SCHEMA}) + "\n"
+        + json.dumps({"t": 0.1, "kind": "x", "n_sets": 1, "path": "teleport"})
+        + "\n"
+    )
+    with pytest.raises(ValueError, match="unknown path"):
+        traffic.read_trace(str(weird))
+
+
+# ---------------------------------------------------------------------------
+# Generators
+# ---------------------------------------------------------------------------
+
+
+def test_generators_deterministic_under_seed():
+    for name, gen in traffic.GENERATORS.items():
+        a, b, c = gen(seed=5), gen(seed=5), gen(seed=6)
+        assert a == b, name
+        assert a != c, name
+        assert a == sorted(a, key=lambda e: e["t"]), name
+        assert all(e["n_sets"] > 0 and e["t"] >= 0 for e in a), name
+
+
+def test_epoch_boundary_flood_shape():
+    """The flood window really floods (attestation arrival rate well
+    above baseline) and every slot carries one verify_now block."""
+    evs = traffic.epoch_boundary_flood(
+        duration_s=12.0, seed=1, flood_start_frac=0.5, flood_width_s=2.0,
+        flood_factor=8.0, slot_s=2.0,
+    )
+    atts = [e for e in evs if e["kind"] in ("unaggregated", "aggregate")]
+    in_flood = [e for e in atts if 6.0 <= e["t"] < 8.0]
+    outside = [e for e in atts if e["t"] < 6.0]
+    rate_in = len(in_flood) / 2.0
+    rate_out = len(outside) / 6.0
+    assert rate_in > 3.0 * rate_out, (rate_in, rate_out)
+    blocks = [e for e in evs if e["kind"] == "block"]
+    assert len(blocks) == 6  # one per slot
+    assert all(e["path"] == "verify_now" for e in blocks)
+
+
+def test_bulk_backfill_shape():
+    evs = traffic.bulk_backfill(duration_s=20.0, seed=2)
+    bulk = [e for e in evs if e["kind"] == "backfill"]
+    assert bulk and all(e["n_sets"] >= 64 for e in bulk)
+    gossip = [e for e in evs if e["kind"] == "unaggregated"]
+    assert gossip  # the trickle keeps running underneath
+
+
+# ---------------------------------------------------------------------------
+# Lockstep determinism
+# ---------------------------------------------------------------------------
+
+
+def test_lockstep_replay_invariants_and_determinism():
+    evs = traffic.epoch_boundary_flood(duration_s=6.0, seed=9)
+    r1 = traffic.lockstep_replay(evs, deadline_ms=25.0, max_batch_sets=64)
+    r2 = traffic.lockstep_replay(evs, deadline_ms=25.0, max_batch_sets=64)
+    assert r1 == r2
+    # conservation: every submitted set is flushed exactly once
+    submitted = sum(n for _, n in r1["submissions"])
+    flushed = sum(fl["n_sets"] for fl in r1["flushes"])
+    assert submitted == flushed
+    assert sum(r1["set_totals"].values()) == submitted + sum(
+        n for _, n in r1["bypasses"]
+    )
+    assert all(fl["mode"] in ("planned", "single") for fl in r1["flushes"])
+    assert all(
+        fl["n_sets"] <= 64 or fl["n_submissions"] == 1
+        for fl in r1["flushes"]
+    )
+    # parameters are part of the function: a different deadline reshapes
+    # the flush sequence (and therefore the digest)
+    r3 = traffic.lockstep_replay(evs, deadline_ms=250.0, max_batch_sets=64)
+    assert r3["digest"] != r1["digest"]
+
+
+def test_replay_determinism_subprocess_jax_free():
+    """Same trace + same seed => byte-identical lockstep report across
+    two fresh processes (submission sequence, flush-plan shapes, set
+    counts), and the trace/generator/plan layer imports no jax — the
+    replay harness must stay runnable on any host."""
+    code = (
+        "import sys\n"
+        "import tools.traffic_replay as t\n"
+        "t.main(['--generate', 'epoch_boundary_flood', '--seed', '11',"
+        " '--duration', '4', '--mode', 'lockstep', '--json'])\n"
+        "assert 'jax' not in sys.modules, 'lockstep replay must stay jax-free'\n"
+    )
+    outs = []
+    for _ in range(2):
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=120, cwd=REPO,
+        )
+        assert r.returncode == 0, r.stderr
+        outs.append(r.stdout.strip().splitlines()[-1])
+    assert outs[0] == outs[1]
+    rec = json.loads(outs[0])
+    assert rec["mode"] == "lockstep"
+    assert rec["digest"] and rec["flushes"]
+    assert rec["set_totals"]["unaggregated"] > 0
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: timed replay against the live scheduler stack
+# ---------------------------------------------------------------------------
+
+
+def _latency_samples() -> dict:
+    m = metrics.get("verification_scheduler_verdict_latency_seconds")
+    return {k: c.total for k, c in m.children().items()} if m else {}
+
+
+def _miss_total() -> float:
+    m = metrics.get("verification_scheduler_deadline_misses_total")
+    return sum(c.value for c in m.children().values()) if m else 0.0
+
+
+def test_epoch_flood_replay_slo_acceptance(recorder):
+    """ISSUE 7 acceptance: replay the epoch-boundary-flood trace through
+    a LIVE scheduler; the report carries nonzero p50/p99 for every kind
+    and path, the verdict-latency family gains samples on at least the
+    fused, shed and bypass paths, and the injected slow flush increments
+    deadline_misses_total with journaled deadline_miss events."""
+    sys.path.insert(0, REPO)
+    import tools.traffic_replay as traffic_replay
+
+    events = traffic.epoch_boundary_flood(duration_s=3.0, seed=11)
+    lat_before = _latency_samples()
+    miss_before = _miss_total()
+    verify = traffic_replay.wrap_slow_flush(
+        traffic_replay.make_stub_verify(0.0005), every=4, slow_s=0.25
+    )
+    report = traffic_replay.run_timed_replay(
+        events,
+        verify_fn=verify,
+        set_factory=traffic.synthetic_sets,
+        deadline_ms=30.0,
+        max_batch_sets=64,
+        max_queue_sets=8,   # tiny bound: the flood must shed
+        time_scale=0.3,
+        plan_flushes=False,  # every device flush resolves on path=fused
+    )
+    assert report["verdicts"]["error"] == 0
+    assert report["verdicts"]["invalid"] == 0
+    assert report["slow_flushes_injected"] > 0
+
+    # per-kind SLO report: nonzero quantiles for every kind and path
+    kinds = report["slo"]["kinds"]
+    assert set(kinds) >= {"unaggregated", "aggregate", "sync_message",
+                          "block"}
+    for kind, rec in kinds.items():
+        assert rec["p50_ms"] > 0 and rec["p99_ms"] > 0, kind
+        assert rec["paths"], kind
+        for path, prec in rec["paths"].items():
+            assert prec["count"] > 0 and prec["p50_ms"] > 0, (kind, path)
+
+    # the histogram family gained samples on fused, shed AND bypass
+    d = {
+        k: v - lat_before.get(k, 0)
+        for k, v in _latency_samples().items()
+        if v - lat_before.get(k, 0) > 0
+    }
+    paths_seen = {path for _, path in d}
+    assert {"fused", "shed", "bypass"} <= paths_seen, paths_seen
+
+    # the injected slow flushes landed as counted + journaled misses
+    assert _miss_total() > miss_before
+    assert report["slo"]["deadline_misses_total"] > 0
+    miss_events = fr.events(kinds=["deadline_miss"])
+    assert miss_events
+    assert all(
+        e["fields"]["latency_ms"] > e["fields"]["budget_ms"]
+        for e in miss_events
+    )
+
+
+def test_fallback_path_with_stub_compile_service(recorder):
+    """Replay with a stub compile service whose rungs never warm in
+    time: every flush routes shed -> the compile-service fallback, so
+    the SLO surface shows path=fallback (the sixth resolution path)."""
+    sys.path.insert(0, REPO)
+    import tools.traffic_replay as traffic_replay
+
+    events = traffic.gossip_steady(duration_s=1.0, seed=4)
+    verify = traffic_replay.make_stub_verify(0.0002)
+    svc = traffic_replay.make_stub_compile_service(
+        verify, compile_s=30.0, rungs=((1024, 16, 8),)
+    )
+    lat_before = _latency_samples()
+    report = traffic_replay.run_timed_replay(
+        events,
+        verify_fn=verify,
+        set_factory=traffic.synthetic_sets,
+        deadline_ms=50.0,
+        time_scale=0.3,
+        compile_service=svc,
+    )
+    assert report["verdicts"]["error"] == 0
+    d = {
+        k: v - lat_before.get(k, 0)
+        for k, v in _latency_samples().items()
+        if v - lat_before.get(k, 0) > 0
+    }
+    assert {path for _, path in d} >= {"fallback"}
+    assert report["compile_service"]["cold_routes"]["shed"] > 0
+    # the global seam was restored
+    from lighthouse_tpu import compile_service as cs_mod
+
+    assert cs_mod.get_service() is None
+
+
+def test_replay_tool_cli_json(tmp_path, recorder):
+    """End-to-end CLI: generate, write the trace, replay it timed, emit
+    the JSON report — the exact invocation bench.py's replay_leg runs
+    (with --verify native there; stub here keeps the gate cheap)."""
+    trace = str(tmp_path / "flood.jsonl")
+    out = str(tmp_path / "report.json")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "traffic_replay.py"),
+         "--generate", "epoch_boundary_flood", "--seed", "7",
+         "--duration", "2", "--time-scale", "0.3",
+         "--deadline-ms", "40", "--verify", "stub:0.0005",
+         "--write-trace", trace, "--json", "--out", out],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stderr
+    report = json.loads(r.stdout.strip().splitlines()[-1])
+    assert report["schema"] == "lighthouse_tpu.replay_report/1"
+    assert report["config"]["verify_backend"].startswith("stub")
+    assert report["slo"]["kinds"]
+    # arrival fidelity is part of the report contract: the tail numbers
+    # are only trustworthy when the dispatch lag is visible
+    assert "p99" in report["dispatch_lag_ms"]
+    assert report["arrival_fidelity"] in (
+        "ok", "degraded:pool_saturated",
+    )
+    # the written trace replays identically through the file path
+    header, evs = traffic.read_trace(trace)
+    assert header["n_events"] == len(evs) == report["n_events"]
+    with open(out) as f:
+        assert json.load(f)["schema"] == report["schema"]

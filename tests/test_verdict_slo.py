@@ -1,0 +1,378 @@
+"""Verdict-latency SLO layer (ISSUE 7 tentpole): every resolution path
+feeds ``verification_scheduler_verdict_latency_seconds{kind,path}``, a
+verdict landing after ``deadline_ms`` (measured from SUBMISSION time,
+whatever flush trigger fired) ticks
+``verification_scheduler_deadline_misses_total{kind}`` and journals a
+``deadline_miss`` event, and the rolling per-kind window surfaces
+p50/p99 + miss ratio at ``/lighthouse/health``'s ``slo`` block.
+
+The latency blind spot this closes: queue-wait used to be sampled only
+on the fused-flush path — shed, bypass and compile-service fallback
+resolutions were invisible, so tail numbers could be flattered by
+exactly the paths that are slow. The replay-harness acceptance drive
+lives in ``tests/test_traffic_replay.py``.
+"""
+
+import threading
+import time
+
+import pytest
+
+from lighthouse_tpu.crypto import backend, bls
+from lighthouse_tpu.utils import flight_recorder as fr
+from lighthouse_tpu.utils import metrics
+from lighthouse_tpu.verification_service import (
+    SloTracker,
+    VerificationScheduler,
+)
+
+
+@pytest.fixture
+def fake_backend():
+    backend.set_backend("fake")
+    yield
+    backend.set_backend("cpu")
+
+
+@pytest.fixture
+def recorder(tmp_path):
+    prev = fr.configure(
+        capacity=256, enabled=True, dump=False, dump_dir=str(tmp_path),
+    )
+    fr.clear()
+    try:
+        yield
+    finally:
+        fr.configure(**prev)
+        fr.clear()
+
+
+_SK = bls.SecretKey(7)
+_PK = bls.PublicKey.deserialize(_SK.public_key().serialize())
+_MSG = b"\x11" * 32
+_SIG = bls.Signature.deserialize(_SK.sign(_MSG).serialize())
+
+
+def _set(n_pks: int = 1) -> bls.SignatureSet:
+    return bls.SignatureSet.multiple_pubkeys(_SIG, [_PK] * n_pks, _MSG)
+
+
+def _poisoned() -> bls.SignatureSet:
+    return bls.SignatureSet.multiple_pubkeys(_SIG, [], _MSG)
+
+
+def _latency_samples() -> dict:
+    """(kind, path) -> observation count of the verdict-latency family."""
+    m = metrics.get("verification_scheduler_verdict_latency_seconds")
+    return {k: c.total for k, c in m.children().items()} if m else {}
+
+
+def _miss_counts() -> dict:
+    m = metrics.get("verification_scheduler_deadline_misses_total")
+    return {k[0]: c.value for k, c in m.children().items()} if m else {}
+
+
+def _delta(after: dict, before: dict) -> dict:
+    return {
+        k: v - before.get(k, 0)
+        for k, v in after.items()
+        if v - before.get(k, 0) > 0
+    }
+
+
+def _scheduler(**kw) -> VerificationScheduler:
+    kw.setdefault("deadline_ms", 80.0)
+    kw.setdefault("max_batch_sets", 256)
+    kw.setdefault("max_queue_sets", 1024)
+    return VerificationScheduler(**kw).start()
+
+
+def test_fused_path_feeds_latency_histogram(fake_backend):
+    before = _latency_samples()
+    sched = _scheduler(plan_flushes=False)
+    try:
+        futs = [
+            sched.submit([_set()], "unaggregated"),
+            sched.submit([_set(4)], "aggregate"),
+        ]
+        assert all(f.result(5) for f in futs)
+    finally:
+        sched.stop()
+    d = _delta(_latency_samples(), before)
+    assert d.get(("unaggregated", "fused")) == 1
+    assert d.get(("aggregate", "fused")) == 1
+    summ = sched.slo_summary()
+    assert summ["kinds"]["unaggregated"]["p50_ms"] > 0
+    assert summ["kinds"]["unaggregated"]["paths"]["fused"]["count"] == 1
+    # fast fake backend + generous deadline: no misses
+    assert summ["deadline_misses_total"] == 0
+
+
+def test_planned_sub_batch_path(fake_backend):
+    """A flush the planner splits resolves its members on the sub_batch
+    path — the planned split must not hide from the SLO surface."""
+    before = _latency_samples()
+    sched = _scheduler(plan_flushes=True, max_batch_sets=48)
+    try:
+        futs = [sched.submit([_set(1)], "unaggregated") for _ in range(32)]
+        futs += [sched.submit([_set(8)], "aggregate") for _ in range(16)]
+        assert all(f.result(5) for f in futs)
+    finally:
+        sched.stop()
+    d = _delta(_latency_samples(), before)
+    assert d.get(("unaggregated", "sub_batch"), 0) >= 32
+    assert d.get(("aggregate", "sub_batch"), 0) >= 16
+    assert sched.status()["planner"]["plans_planned_total"] >= 1
+
+
+def test_bisection_path_labels_retried_submissions(fake_backend, recorder):
+    """A poisoned fused batch bisects: every member's latency lands on
+    the bisection path (the retries ARE what the submitter waited for),
+    and verdicts stay per-submission identical."""
+    before = _latency_samples()
+    sched = _scheduler(plan_flushes=False)
+    try:
+        good = [sched.submit([_set()], "unaggregated") for _ in range(3)]
+        bad = sched.submit([_poisoned()], "aggregate")
+        assert [f.result(5) for f in good] == [True] * 3
+        assert bad.result(5) is False
+    finally:
+        sched.stop()
+    d = _delta(_latency_samples(), before)
+    assert d.get(("aggregate", "bisection")) == 1
+    assert d.get(("unaggregated", "bisection"), 0) >= 1
+    assert not any(path == "fused" for _, path in d)
+
+
+def test_shed_path_feeds_histogram(fake_backend, recorder):
+    """Backpressure shed resolves in the caller's thread — its latency
+    must land in the same family (path=shed), not vanish."""
+    release = threading.Event()
+
+    def blocking_verify(sets):
+        release.wait(5)
+        return True
+
+    before = _latency_samples()
+    sched = VerificationScheduler(
+        verify_fn=blocking_verify, deadline_ms=80.0,
+        max_batch_sets=4, max_queue_sets=4,
+    ).start()
+    try:
+        first = sched.submit([_set() for _ in range(4)], "unaggregated")
+        time.sleep(0.05)  # let the flush thread take it (queue now empty)
+        second = sched.submit([_set() for _ in range(4)], "aggregate")
+        time.sleep(0.05)  # queued; next submission would overflow
+        shed = sched.submit([_set()], "sync_message")
+        assert shed.result(5) is True  # resolved synchronously (shed)
+        release.set()
+        assert first.result(5) is True and second.result(5) is True
+    finally:
+        release.set()
+        sched.stop()
+    d = _delta(_latency_samples(), before)
+    assert d.get(("sync_message", "shed")) == 1
+
+
+def test_bypass_path_and_deadline_miss(fake_backend, recorder):
+    """verify_now feeds path=bypass; a bypass slower than the deadline
+    counts as a miss and journals a deadline_miss event — the deadline
+    is an SLO, not just a flush trigger."""
+
+    def slow_verify(sets):
+        time.sleep(0.09)
+        return True
+
+    before = _latency_samples()
+    misses_before = _miss_counts()
+    sched = VerificationScheduler(
+        verify_fn=slow_verify, deadline_ms=40.0,
+    ).start()
+    try:
+        assert sched.verify_now([_set()], "block") is True
+    finally:
+        sched.stop()
+    d = _delta(_latency_samples(), before)
+    assert d.get(("block", "bypass")) == 1
+    assert _delta(_miss_counts(), misses_before).get("block") == 1
+    (ev,) = fr.events(kinds=["deadline_miss"])
+    assert ev["fields"]["kind"] == "block"
+    assert ev["fields"]["path"] == "bypass"
+    # budget = slo_grace (default 2x) * deadline: trigger noise is not a
+    # miss; a backend slower than the whole budget is
+    assert ev["fields"]["budget_ms"] == pytest.approx(80.0)
+    assert ev["fields"]["latency_ms"] > ev["fields"]["budget_ms"]
+    summ = sched.slo_summary()
+    assert summ["deadline_misses_total"] == 1
+    assert summ["kinds"]["block"]["window_miss_ratio"] == 1.0
+
+
+def test_fused_flush_deadline_miss_counted(fake_backend, recorder):
+    """The original blind spot: a flush whose BACKEND time blows the
+    deadline (the flush trigger fired on time) still counts as a miss,
+    measured from submission."""
+
+    def slow_verify(sets):
+        time.sleep(0.12)
+        return True
+
+    misses_before = _miss_counts()
+    sched = VerificationScheduler(
+        verify_fn=slow_verify, deadline_ms=50.0, max_batch_sets=2,
+        plan_flushes=False,
+    ).start()
+    try:
+        futs = [sched.submit([_set()], "unaggregated") for _ in range(2)]
+        assert all(f.result(5) for f in futs)
+    finally:
+        sched.stop()
+    assert _delta(_miss_counts(), misses_before).get("unaggregated") == 2
+    kinds = {e["fields"]["kind"] for e in fr.events(kinds=["deadline_miss"])}
+    assert kinds == {"unaggregated"}
+
+
+def test_fallback_path_via_compile_service(fake_backend, recorder):
+    """With a compile service attached and nothing warm, a flush sheds
+    to the service's CPU fallback — path=fallback in the SLO family and
+    a sample in compile_service_fallback_verify_seconds."""
+    from lighthouse_tpu.compile_service import CompileService
+
+    def instant_compile(b, k, m):
+        return {
+            s: {"seconds": 0.0, "fresh": True}
+            for s in ("stage1", "stage2", "stage3")
+        }
+
+    calls = []
+
+    def fallback(sets):
+        calls.append(len(sets))
+        return True
+
+    svc = CompileService(
+        rungs=((1024, 16, 8),),  # one big rung nothing warms in time
+        compile_rung_fn=lambda b, k, m: (time.sleep(2), instant_compile(b, k, m))[1],
+        fallback_verify_fn=fallback,
+    ).start()
+    before = _latency_samples()
+    fb = metrics.get("compile_service_fallback_verify_seconds")
+    fb_before = fb.snapshot()[0] if fb else 0
+    sched = _scheduler(compile_service=svc, plan_flushes=False)
+    try:
+        assert sched.submit([_set()], "unaggregated").result(5) is True
+    finally:
+        sched.stop()
+        svc.stop()
+    d = _delta(_latency_samples(), before)
+    assert d.get(("unaggregated", "fallback")) == 1
+    assert calls == [1]
+    fb_after = metrics.get(
+        "compile_service_fallback_verify_seconds"
+    ).snapshot()[0]
+    assert fb_after == fb_before + 1
+
+
+def test_verify_now_cold_route_labels_fallback(fake_backend, recorder):
+    """A verify_now that cold-routes to the compile-service CPU fallback
+    files its latency under path=fallback, not bypass — the path follows
+    the RESOLUTION: blaming device dispatch for a cold-route cost would
+    misdirect the operator reading the bypass tail."""
+    from lighthouse_tpu.compile_service import CompileService
+
+    svc = CompileService(
+        rungs=((1024, 16, 8),),
+        compile_rung_fn=lambda b, k, m: (
+            time.sleep(2),
+            {s: {"seconds": 0.0} for s in ("stage1", "stage2", "stage3")},
+        )[1],
+        fallback_verify_fn=lambda sets: True,
+    ).start()
+    before = _latency_samples()
+    sched = _scheduler(compile_service=svc)
+    try:
+        assert sched.verify_now([_set()], "block") is True
+    finally:
+        sched.stop()
+        svc.stop()
+    d = _delta(_latency_samples(), before)
+    assert d.get(("block", "fallback")) == 1
+    assert ("block", "bypass") not in d
+
+
+def test_empty_submission_accounted(fake_backend):
+    before = _latency_samples()
+    sched = _scheduler()
+    try:
+        assert sched.submit([], "unaggregated").result(1) is False
+    finally:
+        sched.stop()
+    assert _delta(_latency_samples(), before).get(
+        ("unaggregated", "empty")
+    ) == 1
+
+
+def test_slo_tracker_rolling_window():
+    """The tracker is a bounded window: quantiles describe the newest
+    samples only, and the miss ratio is window-scoped while totals are
+    lifetime."""
+    t = SloTracker(window=4)
+    for _ in range(4):
+        t.observe("k", "fused", 1.0, True)  # old, slow, missed
+    for _ in range(4):
+        t.observe("k", "fused", 0.010, False)  # new, fast
+    rec = t.summary(deadline_ms=25.0)["kinds"]["k"]
+    assert rec["count_total"] == 8 and rec["window_count"] == 4
+    assert rec["p50_ms"] == 10.0 and rec["p99_ms"] == 10.0
+    assert rec["misses_total"] == 4 and rec["window_misses"] == 0
+    assert rec["window_miss_ratio"] == 0.0
+
+
+def test_health_endpoint_serves_slo_block(fake_backend, recorder):
+    """/lighthouse/health carries the top-level slo block when a
+    scheduler is attached (rolling p50/p99 + miss ratio per kind) and
+    null without one."""
+    import copy
+    import json as _json
+    import urllib.request
+
+    from lighthouse_tpu.beacon_chain import BeaconChain
+    from lighthouse_tpu.http_api import BeaconApiServer
+    from lighthouse_tpu.state_transition import store_replayer
+    from lighthouse_tpu.store import HotColdDB, MemoryStore
+    from lighthouse_tpu.testing.harness import StateHarness
+    from lighthouse_tpu.types.chain_spec import minimal_spec
+    from lighthouse_tpu.types.preset import MINIMAL
+    from lighthouse_tpu.utils.slot_clock import ManualSlotClock
+
+    h = StateHarness(
+        MINIMAL, minimal_spec(), validator_count=8, fork_name="phase0",
+        fake_sign=True,
+    )
+    genesis = copy.deepcopy(h.state)
+    db = HotColdDB(MemoryStore(), h.t, h.spec, store_replayer(h.preset, h.spec))
+    clock = ManualSlotClock(genesis.genesis_time, h.spec.seconds_per_slot)
+    chain = BeaconChain(h.preset, h.spec, h.t, db, genesis, slot_clock=clock)
+    server = BeaconApiServer(chain, port=0).start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(base + "/lighthouse/health", timeout=5) as r:
+            assert _json.load(r)["data"]["slo"] is None
+
+        sched = _scheduler()
+        chain.verification_scheduler = sched
+        try:
+            assert sched.submit([_set()], "unaggregated").result(5) is True
+            with urllib.request.urlopen(
+                base + "/lighthouse/health", timeout=5
+            ) as r:
+                slo = _json.load(r)["data"]["slo"]
+            rec = slo["kinds"]["unaggregated"]
+            assert rec["p50_ms"] > 0 and rec["p99_ms"] > 0
+            assert rec["window_miss_ratio"] == 0.0
+            assert slo["deadline_ms"] == pytest.approx(80.0)
+            assert slo["deadline_misses_total"] == 0
+        finally:
+            chain.verification_scheduler = None
+            sched.stop()
+    finally:
+        server.stop()

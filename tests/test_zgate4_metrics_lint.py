@@ -166,6 +166,15 @@ def test_verification_scheduler_families_registered():
         "verification_scheduler_plans_total": ("counter", ("mode",)),
         "verification_scheduler_plan_subbatches_total": ("counter", ("kind",)),
         "verification_scheduler_plan_lanes_total": ("counter", ("lane",)),
+        # ISSUE 7: verdict-latency SLO layer — every resolution path
+        # feeds the same end-to-end histogram, and the deadline is an
+        # SLO (miss counter), not just a flush trigger
+        "verification_scheduler_verdict_latency_seconds": (
+            "histogram", ("kind", "path"),
+        ),
+        "verification_scheduler_deadline_misses_total": (
+            "counter", ("kind",),
+        ),
     }
     for name, (kind, labels) in want.items():
         m = reg.get(name)
@@ -189,6 +198,9 @@ def test_compile_service_families_registered():
         "compile_service_compiles_total": ("counter", ("stage", "outcome")),
         "compile_service_compile_seconds": ("histogram", ("stage",)),
         "compile_service_cold_routes_total": ("counter", ("action",)),
+        # ISSUE 7: the shed-flush fallback's wall time (the latency a
+        # submission pays on the SLO layer's `fallback` path)
+        "compile_service_fallback_verify_seconds": ("histogram", None),
     }
     for name, (kind, labels) in want.items():
         m = reg.get(name)
@@ -221,6 +233,41 @@ def test_warmup_tool_imports_and_dry_run_lists_ladder(capsys, monkeypatch):
     # an explicit plan overrides the default and is echoed verbatim
     assert warmup.main(["--dry-run", "--rungs", "4:1:1"]) == 0
     assert "B=4 K=1 M=1" in capsys.readouterr().out
+
+
+def test_trace_schema_version_and_generators_documented():
+    """ISSUE 7 CI satellite: the arrival-trace schema constant is a
+    versioned identifier (bumping the format means bumping the version,
+    consciously), and the schema string + every generator in the
+    catalogue is documented in docs/TRAFFIC_REPLAY.md — a trace format
+    is an API surface like the metric names are."""
+    import os
+
+    from lighthouse_tpu.verification_service import traffic
+
+    assert re.fullmatch(
+        r"lighthouse_tpu\.traffic_trace/\d+", traffic.TRACE_SCHEMA
+    ), traffic.TRACE_SCHEMA
+    assert traffic.TRACE_SCHEMA.endswith(f"/{traffic.TRACE_VERSION}")
+    docs = open(
+        os.path.join(
+            os.path.dirname(__file__), "..", "docs", "TRAFFIC_REPLAY.md"
+        )
+    ).read()
+    assert f"`{traffic.TRACE_SCHEMA}`" in docs, (
+        "the trace schema version must be documented in "
+        "docs/TRAFFIC_REPLAY.md"
+    )
+    assert traffic.GENERATORS, "generator catalogue must not be empty"
+    for name in traffic.GENERATORS:
+        assert _NAME.match(name), f"generator name not snake_case: {name!r}"
+        assert f"`{name}`" in docs, (
+            f"generator {name!r} missing from docs/TRAFFIC_REPLAY.md — "
+            f"the catalogue must stay documented"
+        )
+    # the replay driver imports cleanly (its jax-free property is
+    # subprocess-pinned in tests/test_traffic_replay.py)
+    import tools.traffic_replay  # noqa: F401
 
 
 def test_journal_event_kinds_snake_case_and_documented():

@@ -270,6 +270,20 @@ def main() -> None:
       "and the shared waste gauges in "
       "[OBSERVABILITY.md](OBSERVABILITY.md); plans inspectable offline "
       "via `tools/flush_plan_report.py`).")
+    w("- Measured tails, not just means: the analytic per-batch cost "
+      "above prices the MEAN dispatch; what a submitter experiences is "
+      "the submit-to-verdict TAIL under a real arrival process (queue "
+      "wait + the batch its flush landed in + any fallback/bisection "
+      "detour). The traffic-replay harness drives the scheduler with "
+      "mainnet-shaped arrival traces (epoch-boundary floods, "
+      "sync-committee periods, backfill under gossip) and certifies "
+      "per-kind p50/p99 and deadline-miss ratio against this model's "
+      "per-batch costs — "
+      "`verification_scheduler_verdict_latency_seconds{kind,path}` per "
+      "resolution path, rolling window at `/lighthouse/health` `slo`, "
+      "`replay_leg` in the bench JSON "
+      "([TRAFFIC_REPLAY.md](TRAFFIC_REPLAY.md); families in "
+      "[OBSERVABILITY.md](OBSERVABILITY.md)).")
     w("- Setup cost, not in these tables: the FIRST dispatch of each "
       "staged program at a fresh bucket shape pays the XLA compile "
       "(~120 s for the B=64 headline rung on this host, BENCH_r05 / the "

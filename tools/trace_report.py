@@ -16,6 +16,18 @@ cannot hang the report):
 
 The first rep includes jit compile (visible as the long stage spans);
 pass ``--reps 2`` to also capture warm-cache dispatches.
+
+``--replay <trace.jsonl>`` switches the workload to a TRAFFIC REPLAY
+(ISSUE 7, docs/TRAFFIC_REPLAY.md): the arrival trace is replayed
+against a live verification scheduler under tracing (stub backend —
+the scheduling layer is the subject, no jax needed), so the chrome
+trace shows every ``scheduler.flush`` / ``scheduler.sub_batch`` /
+``scheduler.bypass`` / ``scheduler.shed_fallback`` span over the
+arrival timeline, and the printed summary carries the per-kind SLO
+report instead of stage quantiles:
+
+    python tools/trace_report.py --replay /tmp/flood.jsonl \\
+        --time-scale 0.5 -o /tmp/replay_trace.json
 """
 
 from __future__ import annotations
@@ -57,6 +69,48 @@ def stage_quantile_summary() -> dict:
     return stage_latency_summary()
 
 
+def replay_main(args) -> None:
+    """--replay mode: arrival-trace replay under tracing — the chrome
+    view of a whole replay run (scheduler flush/sub-batch/bypass/shed
+    spans on the arrival timeline) plus the per-kind SLO summary."""
+    from lighthouse_tpu.utils import tracing
+    from lighthouse_tpu.verification_service import traffic
+
+    import tools.traffic_replay as traffic_replay
+
+    header, events = traffic.read_trace(args.replay)
+    tracing.enable()
+    tracing.clear()
+    verify_fn, backend_name, set_factory = traffic_replay.resolve_verify(
+        args.verify
+    )
+    report = traffic_replay.run_timed_replay(
+        events,
+        verify_fn=verify_fn,
+        set_factory=set_factory,
+        deadline_ms=args.deadline_ms,
+        time_scale=args.time_scale,
+    )
+    n = tracing.export_chrome(args.out)
+    print(
+        json.dumps(
+            {
+                "trace": args.out,
+                "events": n,
+                "dropped": tracing.dropped(),
+                "replayed": {
+                    "trace_file": args.replay,
+                    "name": header.get("name"),
+                    "n_events": len(events),
+                    "verify_backend": backend_name,
+                    "wall_s": report["wall_s"],
+                },
+                "slo": report["slo"],
+            }
+        )
+    )
+
+
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("-o", "--out", default="/tmp/bls_trace.json",
@@ -68,12 +122,28 @@ def main(argv=None) -> None:
                     help="verify repetitions (first includes compile)")
     ap.add_argument("--cpu", action="store_true",
                     help="pin JAX_PLATFORMS=cpu before importing jax")
+    ap.add_argument("--replay", default=None, metavar="TRACE",
+                    help="chrome-trace a traffic replay of this arrival "
+                    "trace instead of the staged verify workload")
+    ap.add_argument("--verify", default="stub:0.0005",
+                    help="replay backend (--replay only; see "
+                    "tools/traffic_replay.py)")
+    ap.add_argument("--deadline-ms", type=float, default=25.0,
+                    help="replay scheduler deadline (--replay only)")
+    ap.add_argument("--time-scale", type=float, default=1.0,
+                    help="replay arrival-time multiplier (--replay only)")
     args = ap.parse_args(argv)
     if args.reps < 1:
         ap.error("--reps must be >= 1")
 
     if args.cpu:
+        # BEFORE the replay dispatch: --replay --verify device must
+        # honour the platform pin exactly like the staged workload does
         os.environ["JAX_PLATFORMS"] = "cpu"
+
+    if args.replay:
+        replay_main(args)
+        return
 
     from lighthouse_tpu.utils import tracing
 
