@@ -46,7 +46,7 @@ import time
 from collections import deque
 from typing import Callable, Iterable, Optional, Sequence, Tuple
 
-from ..utils import flight_recorder, metrics, tracing
+from ..utils import flight_recorder, metrics, tracing, transfer_ledger
 from ..verification_service import planner as _planner
 from ..verification_service import round_up_bucket
 from . import cache as _cache
@@ -448,32 +448,43 @@ class CompileService:
         backend's infinity pre-screens, and exceptions PROPAGATE like the
         direct call's would (the scheduler's bisection delivers them to
         exactly the leaf submission that caused them)."""
-        with tracing.span(
-            "compile_service.fallback_verify", n_sets=len(sets)
-        ), _FALLBACK_SECONDS.time():
-            if self._fallback_fn is not None:
-                return bool(self._fallback_fn(list(sets)))
-            from ..crypto import bls as _bls
+        try:
+            with tracing.span(
+                "compile_service.fallback_verify", n_sets=len(sets)
+            ), _FALLBACK_SECONDS.time():
+                if self._fallback_fn is not None:
+                    return bool(self._fallback_fn(list(sets)))
+                from ..crypto import bls as _bls
 
-            prepared = []
-            for item in sets:
-                if isinstance(item, _bls.SignatureSet):
-                    if not item.signing_keys or item.signature.is_infinity():
-                        return False
-                    if any(pk.point.is_infinity() for pk in item.signing_keys):
-                        return False
-                    prepared.append(
-                        (
-                            item.signature,
-                            [pk.point for pk in item.signing_keys],
-                            item.message,
+                prepared = []
+                for item in sets:
+                    if isinstance(item, _bls.SignatureSet):
+                        if not item.signing_keys or item.signature.is_infinity():
+                            return False
+                        if any(pk.point.is_infinity() for pk in item.signing_keys):
+                            return False
+                        prepared.append(
+                            (
+                                item.signature,
+                                [pk.point for pk in item.signing_keys],
+                                item.message,
+                            )
                         )
+                    else:
+                        prepared.append(item)
+                return bool(
+                    self._fallback_backend_inst().verify_signature_sets(
+                        prepared
                     )
-                else:
-                    prepared.append(item)
-            return bool(
-                self._fallback_backend_inst().verify_signature_sets(prepared)
-            )
+                )
+        finally:
+            # data-movement ledger: a CPU resolution ships ZERO
+            # host→device bytes — the zero row keeps byte attribution
+            # exactly-once across resolution paths (kind/path from the
+            # scheduler's attribution context on this thread). In a
+            # finally so a raising verify still journals exactly one
+            # row, mirroring the device path's raise behavior
+            transfer_ledger.record_cpu(len(sets))
 
     def _fallback_backend_inst(self):
         if self._fallback_backend is None:

@@ -40,7 +40,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ...utils import flight_recorder, metrics, tracing
+from ...utils import flight_recorder, metrics, tracing, transfer_ledger
 from ..params import DST, G1_X, G1_Y, P, R, X
 from ..cpu.pairing import PSI_CX, PSI_CY
 from ..cpu.hash_to_curve import hash_to_g2
@@ -398,10 +398,11 @@ _VERIFY_SECONDS = metrics.histogram_vec(
     ("path", "fp_impl"),
     buckets=_STAGE_BUCKETS,
 )
-_PACK_SECONDS = metrics.histogram(
-    "bls_device_pack_seconds",
-    "host-side batch packing (byte wrangling, randomness, hash_to_field)",
-)
+# bls_device_pack_seconds became a phase-labeled family owned by the
+# data-movement ledger (utils/transfer_ledger.py, ISSUE 8): the raw
+# packer observes decode/limb_split/pad/hash/device_put + total; the
+# non-instrumented packers observe total only via this handle.
+_PACK_TOTAL = transfer_ledger.PACK_SECONDS.with_labels("total")
 _RECOMPILES = metrics.counter_vec(
     "bls_device_recompiles_total",
     "fresh (shape, dtype, fp_impl) argument signatures per staged program "
@@ -533,6 +534,17 @@ def stage_latency_summary(impl: str | None = None) -> dict:
                 else f"verify:{path}:{child_impl}"
             )
             out[key] = row
+    # host-pack phase attribution (data-movement ledger, ISSUE 8): the
+    # pack family is phase-labeled, engine-independent — the rows ride
+    # along keyed pack:<phase> so bench/trace readers see where host
+    # pack time goes next to the device stage split
+    for (phase,), child in sorted(
+        transfer_ledger.PACK_SECONDS.children().items()
+    ):
+        row = _row(child, "-")
+        if row:
+            row.pop("fp_impl", None)
+            out[f"pack:{phase}"] = row
     return out
 
 
@@ -545,21 +557,28 @@ def verify_batch_raw_staged(
     (batch geometry, fp_impl, per-stage dispatch-to-sync seconds,
     verdict, recompile flag); a False verdict triggers
     ``dump_on_failure`` so the surrounding context is preserved."""
-    (sig_xy, mx, my, minf, sig_ok), s1, f1 = _run_stage(
-        "stage1", _stage1, sig_x, sig_larger, msg_u
-    )
-    outs, s2, f2 = _run_stage(
-        "stage2", _stage2, pk_xy, pk_mask, sig_xy, rand_bits, set_mask
-    )
-    pk_x, pk_y, pk_inf, acc_x, acc_y, acc_inf, flags_ok = outs
-    msg_aff_x = jnp.take(mx, msg_idx, axis=0)
-    msg_aff_y = jnp.take(my, msg_idx, axis=0)
-    msg_aff_inf = jnp.take(minf, msg_idx, axis=0)
-    pair_ok, s3, f3 = _run_stage(
-        "stage3", _stage3,
-        pk_x, pk_y, pk_inf, msg_aff_x, msg_aff_y, msg_aff_inf,
-        acc_x, acc_y, acc_inf,
-    )
+    try:
+        (sig_xy, mx, my, minf, sig_ok), s1, f1 = _run_stage(
+            "stage1", _stage1, sig_x, sig_larger, msg_u
+        )
+        outs, s2, f2 = _run_stage(
+            "stage2", _stage2, pk_xy, pk_mask, sig_xy, rand_bits, set_mask
+        )
+        pk_x, pk_y, pk_inf, acc_x, acc_y, acc_inf, flags_ok = outs
+        msg_aff_x = jnp.take(mx, msg_idx, axis=0)
+        msg_aff_y = jnp.take(my, msg_idx, axis=0)
+        msg_aff_inf = jnp.take(minf, msg_idx, axis=0)
+        pair_ok, s3, f3 = _run_stage(
+            "stage3", _stage3,
+            pk_x, pk_y, pk_inf, msg_aff_x, msg_aff_y, msg_aff_inf,
+            acc_x, acc_y, acc_inf,
+        )
+    except BaseException:
+        # the pack's bytes already shipped and were counted: its ledger
+        # row must land (verdict null, nothing read back) — one journal
+        # row per pack, raise or not, and never a stale staged row
+        transfer_ledger.commit_verify(None, d2h_bytes=0)
+        raise
     out = pair_ok & flags_ok & jnp.all(sig_ok | ~set_mask)
     # every stage output is already synced, so the verdict read is free
     verdict = bool(out)
@@ -574,6 +593,10 @@ def verify_batch_raw_staged(
         stage1_s=round(s1, 6), stage2_s=round(s2, 6), stage3_s=round(s3, 6),
         recompiled=bool(f1 or f2 or f3), verdict=verdict, **geometry,
     )
+    # the data-movement row this thread's pack staged (transfer_ledger):
+    # the verdict read is the only device→host transfer of a staged
+    # verify — intermediates stay on device by design
+    transfer_ledger.commit_verify(verdict, d2h_bytes=int(out.nbytes))
     if not verdict:
         flight_recorder.dump_on_failure("stage_verify_failure", **geometry)
     return out
@@ -717,7 +740,16 @@ def pack_signature_sets_raw(
 ):
     """Fully-raw packing for :func:`verify_batch_raw_fn`: ``sets`` are
     ``(Signature-object, [pk_points], message)`` triples. Signatures stay
-    COMPRESSED — only byte parsing happens here; no host sqrt."""
+    COMPRESSED — only byte parsing happens here; no host sqrt.
+
+    Instrumented as the data-movement ledger's measured pack (ISSUE 8):
+    phases ``decode`` (signature byte parsing + randomness),
+    ``limb_split`` (int→limb conversion + array fill), ``pad``
+    (allocation + padding-lane fill), ``hash`` (message hash_to_field),
+    ``device_put`` (host→device transfer) land in
+    ``bls_device_pack_seconds{phase}``; per-operand byte splits and the
+    packed pubkey rows feed ``utils/transfer_ledger.note_pack``."""
+    t_start = time.perf_counter()
     sets = list(sets)
     B = pad_b or _round_up(len(sets))
     K = pad_k or _round_up(max(len(pks) for _, pks, _ in sets))
@@ -728,37 +760,58 @@ def pack_signature_sets_raw(
     sig_larger = np.zeros((B,), bool)
     rand = np.zeros((B, 2), np.int32)
     set_mask = np.zeros((B,), bool)
+    t_pad = time.perf_counter() - t_start
 
     from .. import bls as _bls
 
+    # with the ledger off, the packer must not pay for it either: no
+    # per-pubkey blob copies, no device sync (note_pack would drop them)
+    ledger_on = transfer_ledger.enabled()
+    t_decode = t_limb = 0.0
+    pk_blobs: list = []
+    pk_slots = 0
     for i, (sig, pks, _msg) in enumerate(sets):
+        t0 = time.perf_counter()
+        x0, x1, larger = _bls.parse_compressed_g2_x(sig.serialize())
+        hi, lo = _rand_scalar_words()
+        t1 = time.perf_counter()
+        t_decode += t1 - t0
         xy, _ = curve.pack_g1(pks)
         pk_xy[i, : len(pks)] = xy
         pk_mask[i, : len(pks)] = True
-        x0, x1, larger = _bls.parse_compressed_g2_x(sig.serialize())
         sig_x[i, 0] = fp.int_to_limbs(x0)
         sig_x[i, 1] = fp.int_to_limbs(x1)
         sig_larger[i] = larger
-        hi, lo = _rand_scalar_words()
         rand[i] = (np.int32(np.uint32(hi)), np.int32(np.uint32(lo)))
         set_mask[i] = True
+        t_limb += time.perf_counter() - t1
+        pk_slots += len(pks)
+        if ledger_on:
+            for j in range(len(pks)):
+                pk_blobs.append(xy[j].tobytes())
     if B > len(sets):
         # padding lanes: the generator's x (a valid curve x) keeps the
         # decompression uniform; their result is masked out
+        t0 = time.perf_counter()
         from ..cpu.curve import g2_generator
 
         g = g2_generator()
         sig_x[len(sets):, 0] = fp.int_to_limbs(g.x.c0.n)
         sig_x[len(sets):, 1] = fp.int_to_limbs(g.x.c1.n)
+        t_pad += time.perf_counter() - t0
 
+    t0 = time.perf_counter()
     msgs, idx = _dedup_messages([m for _, _, m in sets], pad_m)
+    m_req = int(idx.max()) + 1 if len(idx) else 1  # distinct live messages
     msg_idx = np.zeros((B,), np.int32)
     msg_idx[: len(sets)] = idx
     from . import htc
 
     msg_u = htc.messages_to_u(msgs, DST)
+    t_hash = time.perf_counter() - t0
 
-    return (
+    t0 = time.perf_counter()
+    args = (
         jnp.asarray(pk_xy),
         jnp.asarray(pk_mask),
         jnp.asarray(sig_x),
@@ -768,6 +821,40 @@ def pack_signature_sets_raw(
         jnp.asarray(rand),
         jnp.asarray(set_mask),
     )
+    if ledger_on:
+        # async backends (real TPU) return from asarray while the DMA
+        # is in flight: block so the phase measures the TRANSFER, not
+        # the enqueue — otherwise the effective-H2D-bandwidth evidence
+        # is inflated exactly on the device it is meant to size. Gated:
+        # with the ledger off the hot path keeps its transfer/dispatch
+        # overlap and pays nothing, and the device_put semantics change
+        # is DOCUMENTED in the family help (enqueue-only when disabled
+        # on async backends)
+        jax.block_until_ready(args)
+    t_dput = time.perf_counter() - t0
+
+    phases = {
+        "decode": t_decode, "limb_split": t_limb, "pad": t_pad,
+        "hash": t_hash, "device_put": t_dput,
+    }
+    total_s = time.perf_counter() - t_start
+    # the pack histogram is always-on (it predates the ledger); only the
+    # byte accounting below is behind the ledger knob
+    transfer_ledger.observe_pack_phases(phases, total_s)
+    transfer_ledger.note_pack(
+        n_sets=len(sets), b=B, k=K, m=int(msg_u.shape[0]),
+        pk_slots=pk_slots, m_req=m_req,
+        phases=phases,
+        total_s=total_s,
+        operand_nbytes={
+            "pubkeys": pk_xy.nbytes + pk_mask.nbytes,
+            "signatures": sig_x.nbytes + sig_larger.nbytes,
+            "messages": msg_u.nbytes + msg_idx.nbytes,
+            "aux": rand.nbytes + set_mask.nbytes,
+        },
+        pubkey_blobs=pk_blobs,
+    )
+    return args
 
 
 class TpuBackend:
@@ -830,13 +917,16 @@ class TpuBackend:
         with tracing.span(
             "bls.verify_signature_sets", path=path, n_sets=len(sets)
         ) as sp, _VERIFY_SECONDS.with_labels(path, impl).time():
-            with tracing.span("bls.pack"), _PACK_SECONDS.time():
+            with tracing.span("bls.pack"):
                 if raw_mode:
+                    # the raw packer observes its own phase-labeled pack
+                    # times (incl. total) into the data-movement ledger
                     args = pack_signature_sets_raw(
                         sets, pad_b=pad_b, pad_k=pad_k, pad_m=pad_m
                     )
                 else:
-                    args = pack_signature_sets_hashed(sets)
+                    with _PACK_TOTAL.time():
+                        args = pack_signature_sets_hashed(sets)
             self._record_geometry(sets, args, k_req=k_req, m_req=m_req)
             if raw_mode:
                 out = bool(verify_batch_raw_staged(*args))

@@ -427,6 +427,14 @@ class BeaconApiServer:
             # persistent-cache state (null when the node runs without one)
             csvc = getattr(chain, "compile_service", None)
             doc["compile_service"] = None if csvc is None else csvc.status()
+            # data-movement ledger (ISSUE 8): per-operand/per-kind H2D
+            # bytes, pack-phase seconds + pack share of verify wall,
+            # repeat-pubkey re-upload window, device memory — the
+            # evidence base for the device-resident pubkey table
+            # (ROADMAP item 2); rendered by tools/transfer_report.py
+            from ..utils import transfer_ledger
+
+            doc["data_movement"] = transfer_ledger.summary()
             return {"data": doc}
         if path == "/lighthouse/flight_recorder":
             # live journal tail: ?kind=a,b filters, ?limit=N bounds the

@@ -72,6 +72,7 @@ EVENT_KINDS = (
     "scheduler_plan",         # verification_service/batcher.py, per flush plan
     "scheduler_shed",         # verification_service/batcher.py, backpressure
     "sync_rejected",          # beacon_chain/sync_committee_verification.py
+    "transfer_ledger",        # utils/transfer_ledger.py, one per verify
 )
 _KINDS = frozenset(EVENT_KINDS)
 

@@ -105,7 +105,7 @@ from concurrent.futures import Future
 from typing import Callable, List, Optional, Sequence
 
 from ..crypto import bls
-from ..utils import flight_recorder, metrics, tracing
+from ..utils import flight_recorder, metrics, tracing, transfer_ledger
 from .slo import SloTracker
 
 # Mirrors crypto/device/bls._round_up's choices without importing the
@@ -465,8 +465,10 @@ class VerificationScheduler:
                         # cold-route cost (the other fallback call sites
                         # already label it this way)
                         path = "fallback"
-                        return svc.fallback_verify(sets)
-                return self._verify(sets)
+                        with transfer_ledger.context(kind, path):
+                            return svc.fallback_verify(sets)
+                with transfer_ledger.context(kind, path):
+                    return self._verify(sets)
         finally:
             # the bypass IS this caller's end-to-end latency: no queue,
             # but a cold-route fallback or a slow device dispatch can
@@ -568,6 +570,8 @@ class VerificationScheduler:
             "n_sub_batches": len(plan.sub_batches),
             "rungs": plan.rungs_label(),
             "padding_waste": round(waste, 4),
+            "est_h2d_bytes": plan.est_h2d_bytes,
+            "est_live_h2d_bytes": plan.est_live_h2d_bytes,
         }
         bisections_before = self._bisections
         all_ok = True
@@ -657,6 +661,8 @@ class VerificationScheduler:
             padded_lanes=plan.padded,
             legacy_padded_lanes=plan.legacy_padded,
             waste=round(waste, 4),
+            est_h2d_bytes=plan.est_h2d_bytes,
+            est_live_h2d_bytes=plan.est_live_h2d_bytes,
             kinds=kinds_mix,
         )
         flight_recorder.record(
@@ -695,11 +701,18 @@ class VerificationScheduler:
         retries ARE the latency the submitter experienced)."""
         if verify is None:
             verify = self._verify
+        # data-movement attribution (transfer_ledger): the backend pack
+        # under this call charges its bytes to this group's kind mix and
+        # resolution path — a bisection retry's re-packed bytes are real
+        # (the host re-shipped them) but land under path=bisection, so
+        # the original flush's attribution stays exactly-once
+        kinds = "+".join(sorted({s.kind for s in subs}))
         try:
-            ok = bool(verify(
-                fused if fused is not None
-                else [st for s in subs for st in s.sets]
-            ))
+            with transfer_ledger.context(kinds, path):
+                ok = bool(verify(
+                    fused if fused is not None
+                    else [st for s in subs for st in s.sets]
+                ))
         except BaseException as e:  # noqa: BLE001 — flush thread survives
             if len(subs) == 1:
                 sub = subs[0]

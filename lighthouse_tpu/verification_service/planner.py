@@ -51,6 +51,7 @@ from __future__ import annotations
 import os
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
+from ..utils import transfer_ledger
 from .batcher import BUCKET_LADDER, round_up_bucket
 
 Rung = Tuple[int, int, int]  # (B, K, M) padded bucket shape
@@ -145,6 +146,7 @@ class PlannedSubBatch:
     __slots__ = (
         "subs", "sets", "kinds", "n_sets", "k_req", "m_req",
         "pk_slots", "rung", "cold", "live", "padded",
+        "est_h2d_bytes", "est_live_h2d_bytes",
     )
 
     def __init__(self, subs: List, rung: Rung, cold: bool,
@@ -160,6 +162,16 @@ class PlannedSubBatch:
         self.cold = cold
         self.live = live_lanes(pk_slots, m_req)
         self.padded = padded_lanes(*rung)
+        # byte accounting (ISSUE 8): what the raw packer will ship
+        # host→device for this element's padded rung, and the live share
+        # the callers asked for — the shared analytic model pinned
+        # against the packer's actual ndarray.nbytes by test
+        self.est_h2d_bytes = transfer_ledger.operand_bytes_model(
+            *rung
+        )["total"]
+        self.est_live_h2d_bytes = transfer_ledger.live_operand_bytes(
+            n_sets, pk_slots, m_req
+        )["total"]
 
     def waste(self) -> float:
         return padding_waste_ratio(self.live, self.padded)
@@ -173,6 +185,7 @@ class FlushPlan:
     __slots__ = (
         "mode", "sub_batches", "live", "padded",
         "legacy_rung", "legacy_padded", "legacy_cold",
+        "est_h2d_bytes", "est_live_h2d_bytes",
     )
 
     def __init__(self, mode: str, sub_batches: List[PlannedSubBatch],
@@ -184,6 +197,10 @@ class FlushPlan:
         self.legacy_rung = legacy_rung
         self.legacy_padded = padded_lanes(*legacy_rung)
         self.legacy_cold = legacy_cold
+        self.est_h2d_bytes = sum(sb.est_h2d_bytes for sb in sub_batches)
+        self.est_live_h2d_bytes = sum(
+            sb.est_live_h2d_bytes for sb in sub_batches
+        )
 
     def waste(self) -> float:
         return padding_waste_ratio(self.live, self.padded)

@@ -441,6 +441,21 @@ def lockstep_replay(
             "live_lanes": plan.live,
             "padded_lanes": plan.padded,
             "waste": round(plan.waste(), 4),
+            # per-element byte accounting (ISSUE 8): what the raw packer
+            # would ship per sub-batch — tools/transfer_report.py turns
+            # this into per-kind H2D attribution without jax
+            "sub_batches": [
+                {
+                    "kinds": sb.kinds,
+                    "rung": list(sb.rung),
+                    "n_sets": sb.n_sets,
+                    "pk_slots": sb.pk_slots,
+                    "m_req": sb.m_req,
+                    "est_h2d_bytes": sb.est_h2d_bytes,
+                    "est_live_h2d_bytes": sb.est_live_h2d_bytes,
+                }
+                for sb in plan.sub_batches
+            ],
         })
 
     for ev in sorted(events, key=lambda e: e["t"]):

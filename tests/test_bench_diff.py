@@ -1,0 +1,123 @@
+"""tools/bench_diff.py (ISSUE 8 satellite): the bench trajectory's
+regression gate. Synthetic fixtures pin the comparison semantics and
+the nonzero-exit contract; the repo's own latest-vs-previous artifacts
+are diffed as the standing tier-1 gate (LOUD skip when the trajectory
+has fewer than two artifacts — silence must never read as 'gated')."""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+import tools.bench_diff as bench_diff
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _bench_doc(sets_per_sec, waste, wrapped=False):
+    doc = {
+        "metric": "bls_sigset_verifications_per_sec_per_chip",
+        "value": sets_per_sec,
+        "baseline_sets_per_sec": 500.0,
+        "vs_baseline": sets_per_sec / 500.0,
+        "buckets": [{
+            "B": 64, "K": 8, "M": 4, "n_sets": 48,
+            "sets_per_sec": sets_per_sec, "step_s": 9.0,
+            "warmup_s": 100.0, "padding_waste": waste,
+        }],
+        "data_movement": {
+            "h2d_bytes_per_set": 3000.0,
+            "pack_share_of_verify_wall": 0.01,
+            "pubkey_reupload_ratio": 0.8,
+        },
+    }
+    return {"n": 1, "rc": 0, "parsed": doc} if wrapped else doc
+
+
+def _write(tmp_path, name, doc):
+    p = tmp_path / name
+    p.write_text(json.dumps(doc))
+    return str(p)
+
+
+def test_diff_ok_and_wrapper_format(tmp_path):
+    old = _write(tmp_path, "BENCH_r01.json", _bench_doc(5.0, 0.68, wrapped=True))
+    new = _write(tmp_path, "BENCH_r02.json", _bench_doc(5.5, 0.60))
+    assert bench_diff.main([old, new]) == 0
+    rep = bench_diff.diff(
+        bench_diff.load_bench(old), bench_diff.load_bench(new)
+    )
+    assert rep["ok"] and not rep["regressions"]
+    assert rep["gates_skipped"] == []  # both gates evaluated here
+    by = {r["metric"]: r for r in rep["metrics"]}
+    assert by["headline_sets_per_sec"]["delta_pct"] == 10.0
+    assert by["headline_padding_waste"]["new"] == 0.60
+    assert by["data_movement_reupload_ratio"]["old"] == 0.8
+
+
+def test_diff_exits_nonzero_on_regression(tmp_path):
+    # >20% throughput drop
+    old = _write(tmp_path, "a.json", _bench_doc(10.0, 0.5))
+    new = _write(tmp_path, "b.json", _bench_doc(7.0, 0.5))
+    assert bench_diff.main([new, old]) == 0  # improvement direction ok
+    assert bench_diff.main([old, new]) == 1
+    rep = bench_diff.diff(
+        bench_diff.load_bench(old), bench_diff.load_bench(new)
+    )
+    assert rep["regressions"] == ["headline_sets_per_sec"]
+    # >20% padding-waste growth trips the other gate
+    worse = _write(tmp_path, "c.json", _bench_doc(10.0, 0.65))
+    assert bench_diff.main([old, worse]) == 1
+    # within threshold: 10% slower is reported but not gated
+    meh = _write(tmp_path, "d.json", _bench_doc(9.0, 0.5))
+    assert bench_diff.main([old, meh]) == 0
+    # a gate that cannot be evaluated is reported LOUDLY, not silently
+    # dropped (exit stays 0 — absence of data is not a regression)
+    legacy = dict(_bench_doc(10.0, 0.5))
+    legacy.pop("buckets")
+    e = _write(tmp_path, "e.json", legacy)
+    rep = bench_diff.diff(
+        bench_diff.load_bench(e), bench_diff.load_bench(old)
+    )
+    assert rep["ok"] and rep["gates_skipped"] == ["headline_padding_waste"]
+
+
+def test_latest_pair_orders_by_run_number(tmp_path):
+    for n, v in ((1, 4.0), (2, 4.5), (10, 5.0)):
+        _write(tmp_path, f"BENCH_r{n:02d}.json", _bench_doc(v, 0.5))
+    old, new = bench_diff.latest_pair(str(tmp_path))
+    assert old.endswith("BENCH_r02.json")
+    assert new.endswith("BENCH_r10.json")  # r10 sorts after r02 numerically
+    with pytest.raises(FileNotFoundError):
+        bench_diff.latest_pair(str(tmp_path / "empty"))
+
+
+def test_repo_trajectory_gate():
+    """THE standing gate: the repo's newest bench artifact must not have
+    regressed >20% on headline sets/s or padding waste vs its
+    predecessor. Loud-skips when the trajectory is too short."""
+    files = glob.glob(os.path.join(REPO, "BENCH_r*.json"))
+    if len(files) < 2:
+        pytest.skip(
+            f"LOUD SKIP: bench regression gate needs >= 2 BENCH_r*.json "
+            f"artifacts in the repo root, found {len(files)} — the "
+            f"trajectory has no diffable history yet"
+        )
+    r = subprocess.run(
+        [sys.executable, os.path.join("tools", "bench_diff.py"),
+         "--latest", "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=60,
+    )
+    assert r.returncode == 0, (
+        f"bench trajectory REGRESSED (see tools/bench_diff.py --latest):\n"
+        f"{r.stdout}\n{r.stderr}"
+    )
+    rep = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rep["ok"]
+    assert "gates_skipped" in rep  # unevaluated gates are surfaced
+    assert any(
+        m["metric"] == "headline_sets_per_sec" for m in rep["metrics"]
+    )

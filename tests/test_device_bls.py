@@ -185,6 +185,27 @@ def test_staged_verify_b64_matmul_int8(rng, tmp_path):
     assert evs[-1]["fields"]["recompiled"] is False  # same shape as the ok run
     assert all(evs[-1]["fields"][f"stage{i}_s"] > 0.0 for i in (1, 2, 3))
 
+    # ISSUE 8 rides along: both staged verifies committed a
+    # transfer_ledger row with the measured byte attribution, and the
+    # second pack's pubkeys (same keypairs) hit the re-upload window
+    from lighthouse_tpu.utils import transfer_ledger as tl
+
+    tevs = [
+        e for e in fr.events(kinds=("transfer_ledger",))
+        if e["fields"]["b"] == 64
+    ]
+    assert len(tevs) >= 2
+    model_total = tl.operand_bytes_model(64, 8, 4)["total"]
+    for e in tevs[-2:]:
+        f = e["fields"]
+        assert f["h2d_bytes_total"] == model_total
+        assert f["pubkeys_bytes"] + f["signatures_bytes"] \
+            + f["messages_bytes"] + f["aux_bytes"] \
+            + f["padding_bytes"] == model_total
+        assert f["d2h_bytes"] >= 1 and f["pack_s"] > 0.0
+    assert tevs[-1]["fields"]["pubkeys_reuploaded_bytes"] > 0
+    assert tevs[-1]["fields"]["verdict"] is False
+
     # the induced failure dumped an artifact the forensics tool renders
     # with per-stage latency attribution
     dumps = sorted(tmp_path.glob(fr.DUMP_PREFIX + "*stage_verify_failure.json"))

@@ -294,6 +294,13 @@ def test_endpoints_roundtrip_without_validator_client(recorder):
         assert health["network"] == {"peer_count": 0}
         assert health["beacon_processor"] is None
         assert health["flight_recorder"]["recorded_total"] >= 2
+        # data-movement ledger block (ISSUE 8): always present, null-safe
+        # fields on a node that has not packed anything yet
+        dm = health["data_movement"]
+        assert dm["enabled"] in (True, False)
+        assert "h2d_bytes_by_operand" in dm
+        assert "pubkey_reupload" in dm and "window" in dm["pubkey_reupload"]
+        assert "pack_share_of_verify_wall" in dm
 
         from lighthouse_tpu.beacon_processor.processor import (
             BeaconProcessor, WorkKind,

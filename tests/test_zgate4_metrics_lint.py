@@ -33,6 +33,7 @@ KNOWN_PREFIXES = (
     "block_",
     "bls_device_",
     "compile_service_",
+    "device_",  # device_memory_bytes (utils/transfer_ledger.py, ISSUE 8)
     "flight_recorder_",
     "head_",
     "http_api_",
@@ -210,6 +211,43 @@ def test_compile_service_families_registered():
             assert m.labelnames == labels, (name, m.labelnames)
         else:
             assert not hasattr(m, "labelnames"), name  # unlabeled family
+
+
+def test_transfer_ledger_families_registered():
+    """ISSUE 8 families (utils/transfer_ledger.py) exist under their
+    declared types + labels, and the old unlabeled pack histogram is
+    REPLACED by the phase-labeled family (same name, new shape)."""
+    _import_instrumented_modules()
+    reg = metrics.registry_snapshot()
+    want = {
+        "bls_device_h2d_bytes_total": ("counter", ("operand", "kind")),
+        "bls_device_d2h_bytes_total": ("counter", None),
+        "bls_device_pack_seconds": ("histogram", ("phase",)),
+        "bls_device_pubkey_reupload_ratio": ("gauge", ("kind",)),
+        "device_memory_bytes": ("gauge", ("kind",)),
+        "bls_device_ledger_rows_total": ("counter", ("path",)),
+    }
+    for name, (kind, labels) in want.items():
+        m = reg.get(name)
+        assert m is not None, f"family {name} not registered"
+        assert m.kind == kind, (name, m.kind)
+        if labels is not None:
+            assert m.labelnames == labels, (name, m.labelnames)
+        else:
+            assert not hasattr(m, "labelnames"), name  # unlabeled family
+    # pack seconds is labeled now: re-registering unlabeled must raise
+    with pytest.raises(TypeError):
+        metrics.histogram("bls_device_pack_seconds")
+    # the ledger's phase catalogue is what the family carries
+    from lighthouse_tpu.utils import transfer_ledger
+
+    assert set(transfer_ledger.PACK_PHASES) == {
+        "decode", "limb_split", "pad", "hash", "device_put",
+    }
+    # and both new tools import cleanly (jax-freedom is
+    # subprocess-pinned in tests/test_transfer_ledger.py)
+    import tools.bench_diff  # noqa: F401
+    import tools.transfer_report  # noqa: F401
 
 
 def test_warmup_tool_imports_and_dry_run_lists_ladder(capsys, monkeypatch):

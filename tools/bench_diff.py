@@ -1,0 +1,223 @@
+"""Compare two bench artifacts (`BENCH_r0*.json`) and gate regressions.
+
+The bench trajectory (BENCH_r01..r05 + every future run) records the
+headline sets/s, padding waste, startup cost and the per-leg records —
+but nothing ever COMPARED two of them, so a regression only surfaced
+when a human read the numbers. This tool is the missing diff:
+
+    python tools/bench_diff.py BENCH_r04.json BENCH_r05.json
+    python tools/bench_diff.py --latest          # newest vs previous
+    python tools/bench_diff.py --latest --json
+
+Prints per-metric deltas for every metric present in both files and
+exits **nonzero** when the headline throughput regressed by more than
+``--threshold`` (default 20%) or the headline padding waste grew by
+more than the same fraction — the loud gate
+``tests/test_bench_diff.py`` wires into tier-1, so the trajectory
+finally has a regression bar instead of a pile of JSON.
+
+Accepts both the raw ``bench.py`` output and the driver wrapper format
+(``{"parsed": {...}}``) the repo's ``BENCH_r0*.json`` artifacts use.
+Jax-free (pinned by test).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+# (label, path tuple, higher_is_better) — compared when present in BOTH
+METRICS = (
+    ("headline_sets_per_sec", ("value",), True),
+    ("baseline_sets_per_sec", ("baseline_sets_per_sec",), True),
+    ("vs_baseline", ("vs_baseline",), True),
+    ("headline_padding_waste", ("buckets", 0, "padding_waste"), False),
+    ("headline_warmup_s", ("buckets", 0, "warmup_s"), False),
+    ("headline_step_s", ("buckets", 0, "step_s"), False),
+    ("scheduler_fused_vs_direct",
+     ("scheduler_leg", "fused_vs_direct"), True),
+    ("planner_planned_waste", ("planner_leg", "planned", "padding_waste"),
+     False),
+    ("planner_vs_legacy", ("planner_leg", "planned_vs_legacy"), True),
+    ("replay_deadline_misses", ("replay_leg", "deadline_misses_total"),
+     False),
+    ("startup_cold_warmup_s", ("startup", "cold_warmup_s"), False),
+    ("startup_warm_vs_cold", ("startup", "warm_vs_cold"), False),
+    ("data_movement_bytes_per_set",
+     ("data_movement", "h2d_bytes_per_set"), False),
+    ("data_movement_pack_share",
+     ("data_movement", "pack_share_of_verify_wall"), False),
+    ("data_movement_reupload_ratio",
+     ("data_movement", "pubkey_reupload_ratio"), None),
+)
+
+# the two metrics whose regression exits nonzero (the ISSUE 8 gate)
+GATED = ("headline_sets_per_sec", "headline_padding_waste")
+
+
+def load_bench(path: str) -> dict:
+    """One bench document: unwraps the driver format ({"parsed": ...})
+    down to the bench.py JSON line."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
+        doc = doc["parsed"]
+    if not isinstance(doc, dict) or "value" not in doc:
+        raise ValueError(
+            f"{path}: not a bench artifact (no headline 'value' field)"
+        )
+    return doc
+
+
+def _get(doc: dict, path: tuple):
+    cur = doc
+    for step in path:
+        try:
+            cur = cur[step]
+        except (KeyError, IndexError, TypeError):
+            return None
+    return cur if isinstance(cur, (int, float)) and not isinstance(
+        cur, bool
+    ) else None
+
+
+def latest_pair(directory: str) -> tuple:
+    """(previous, latest) bench artifact paths, ordered by the rNN run
+    number in the filename."""
+
+    def run_no(p: str):
+        m = re.search(r"BENCH_r(\d+)\.json$", os.path.basename(p))
+        return int(m.group(1)) if m else -1
+
+    files = sorted(
+        (p for p in glob.glob(os.path.join(directory, "BENCH_r*.json"))
+         if run_no(p) >= 0),
+        key=run_no,
+    )
+    if len(files) < 2:
+        raise FileNotFoundError(
+            f"need at least two BENCH_r*.json files in {directory!r}, "
+            f"found {len(files)}"
+        )
+    return files[-2], files[-1]
+
+
+def diff(old: dict, new: dict, threshold: float = 0.20) -> dict:
+    """Per-metric deltas + the regression verdict. A gated metric
+    regresses when it moved against its direction by more than
+    ``threshold`` (relative; an absolute slack of 0.02 keeps
+    near-zero ratios from tripping on noise)."""
+    rows = []
+    regressions = []
+    gates_skipped = []
+    for label, path, higher_better in METRICS:
+        ov, nv = _get(old, path), _get(new, path)
+        if ov is None or nv is None:
+            if label in GATED:
+                # a gate that could not be evaluated must be LOUD —
+                # silence would read as "gated OK"
+                gates_skipped.append(label)
+            continue
+        delta = nv - ov
+        rel = (delta / abs(ov)) if ov else None
+        row = {
+            "metric": label,
+            "old": ov,
+            "new": nv,
+            "delta": round(delta, 6),
+            "delta_pct": round(rel * 100.0, 2) if rel is not None else None,
+            "higher_is_better": higher_better,
+        }
+        regressed = False
+        if label in GATED and higher_better is not None:
+            if higher_better:
+                regressed = nv < ov * (1.0 - threshold)
+            else:
+                regressed = nv > ov * (1.0 + threshold) + 0.02
+        row["regressed"] = regressed
+        if regressed:
+            regressions.append(label)
+        rows.append(row)
+    return {
+        "schema": "lighthouse_tpu.bench_diff/1",
+        "threshold": threshold,
+        "metrics": rows,
+        "regressions": regressions,
+        "gates_skipped": gates_skipped,
+        "ok": not regressions,
+    }
+
+
+def render(report: dict, old_path: str, new_path: str) -> str:
+    lines = [
+        f"bench diff: {os.path.basename(old_path)} -> "
+        f"{os.path.basename(new_path)} "
+        f"(gate: >{report['threshold'] * 100:.0f}% regression of "
+        f"{' / '.join(GATED)})",
+        f"  {'metric':<34}{'old':>12}{'new':>12}{'delta%':>9}",
+    ]
+    for r in report["metrics"]:
+        pct = "" if r["delta_pct"] is None else f"{r['delta_pct']:+.1f}%"
+        flag = "  << REGRESSION" if r["regressed"] else ""
+        lines.append(
+            f"  {r['metric']:<34}{r['old']:>12g}{r['new']:>12g}"
+            f"{pct:>9}{flag}"
+        )
+    for g in report.get("gates_skipped", ()):
+        lines.append(
+            f"  WARNING: gate {g} NOT evaluated (metric missing from "
+            f"one artifact) — this comparison is only partially gated"
+        )
+    lines.append(
+        "  OK (no gated regression)" if report["ok"]
+        else f"  REGRESSED: {', '.join(report['regressions'])}"
+    )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("files", nargs="*", help="OLD NEW bench JSON files")
+    ap.add_argument("--latest", action="store_true",
+                    help="compare the two newest BENCH_r*.json in --dir")
+    ap.add_argument("--dir", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    ), help="directory searched by --latest (default: repo root)")
+    ap.add_argument("--threshold", type=float, default=0.20,
+                    help="relative regression gate (default 0.20)")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.latest:
+        if args.files:
+            raise SystemExit("--latest takes no positional files")
+        try:
+            old_path, new_path = latest_pair(args.dir)
+        except FileNotFoundError as e:
+            raise SystemExit(str(e))
+    elif len(args.files) == 2:
+        old_path, new_path = args.files
+    else:
+        raise SystemExit("need OLD NEW file arguments or --latest")
+
+    try:
+        old, new = load_bench(old_path), load_bench(new_path)
+    except (OSError, ValueError) as e:
+        raise SystemExit(str(e))
+
+    report = diff(old, new, threshold=args.threshold)
+    report["old_file"] = old_path
+    report["new_file"] = new_path
+    if args.json:
+        print(json.dumps(report))
+    else:
+        print(render(report, old_path, new_path))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

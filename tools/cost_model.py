@@ -21,6 +21,7 @@ REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
 from lighthouse_tpu.crypto.params import P, X  # noqa: E402
+from lighthouse_tpu.utils import transfer_ledger  # noqa: E402  (jax-free)
 
 # ---------------------------------------------------------------------------
 # Primitive lane counts (cite: crypto/device/{fp,fp2,curve,tower,pairing}.py)
@@ -219,6 +220,57 @@ def main() -> None:
               "above is only validated by a run with backend `tpu`; a CPU "
               "ratio measures XLA:CPU's int8 vs int32 vectorization.")
         w("")
+    # Data-movement table (ISSUE 8): the shared byte model
+    # (utils/transfer_ledger.operand_bytes_model, pinned against the raw
+    # packer's actual ndarray.nbytes by tests/test_transfer_ledger.py) at
+    # the rungs the flush planner actually dispatches — the sizing input
+    # for ROADMAP item 2 (device-resident pubkey table).
+    w("## Bytes per set, host→device (data-movement ledger model)")
+    w("")
+    w("Per-operand H2D bytes of one raw-packed batch at dispatched "
+      "rungs, divided by B (the `operand_bytes_model` in "
+      "`utils/transfer_ledger.py` — equality with the packer's real "
+      "`ndarray.nbytes` is pinned by test). `pubkey share` is the "
+      "fraction of all shipped bytes that is G1 pubkeys — the ceiling "
+      "of ROADMAP item 2's device-resident-table win; the MEASURED "
+      "counterpart is `bls_device_h2d_bytes_total{operand,kind}` and "
+      "the bench `data_movement` block — NOTE the base: the measured "
+      "`pubkeys` label counts LIVE bytes with padded-lane bytes under "
+      "the separate `padding` label, while this table charges the full "
+      "padded rung, so compare measured shares against the live base "
+      "(total − padding); at full occupancy the two coincide. The "
+      "realized win is that share times the measured "
+      "`bls_device_pubkey_reupload_ratio` (gossip steady-state models "
+      "at >0.9 over a few epochs — `tools/transfer_report.py`).")
+    w("")
+    w("| rung BxKxM | pubkeys B/set | signatures | messages | aux | "
+      "total B/set | pubkey share |")
+    w("|---|---|---|---|---|---|---|")
+    for b, k, m in (
+        (64, 8, 4),      # headline bucket
+        (48, 8, 4),      # exact headline rung (planner)
+        (32, 1, 8),      # kind-homogeneous unaggregated
+        (16, 16, 8),     # kind-homogeneous aggregate
+        (256, 16, 8),    # the large-B end the scheduler amortizes to
+    ):
+        ops = transfer_ledger.operand_bytes_model(b, k, m)
+        w(
+            f"| {b}x{k}x{m} | {ops['pubkeys'] / b:,.0f} | "
+            f"{ops['signatures'] / b:,.0f} | {ops['messages'] / b:,.0f} | "
+            f"{ops['aux'] / b:,.0f} | {ops['total'] / b:,.0f} | "
+            f"{ops['pubkeys'] / ops['total'] * 100:.1f}% |"
+        )
+    w("")
+    w("Pubkeys dominate at every committee width — exactly the operand "
+      "a device-resident table keyed by validator index removes from "
+      "the hot path (`submit()` would carry indices; the pack becomes "
+      "a device-side gather). Host pack time is attributed per phase "
+      "alongside (`bls_device_pack_seconds{phase}`: decode, limb_split, "
+      "pad, hash, device_put), so the pack-second share of the claim "
+      "is measured too ([OBSERVABILITY.md](OBSERVABILITY.md) "
+      "data-movement section; per-verify rows in the `transfer_ledger` "
+      "journal events).")
+    w("")
     w("## Reading the table")
     w("")
     w("- The 50k agg/s target (150k sets/s, BASELINE.json) needs ~"

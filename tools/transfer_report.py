@@ -1,0 +1,397 @@
+"""Render host↔device data-movement attribution (ISSUE 8) — from a live
+node's `/lighthouse/health` or, jax-free, from an arrival-trace replay.
+
+ROADMAP item 2 (device-resident validator pubkey table) needs a sized
+win before it is built: how many host→device bytes are pubkeys, and how
+many of those are RE-uploads of keys the device saw moments ago. This
+tool renders that evidence base:
+
+    # live node (or a saved health document)
+    python tools/transfer_report.py --url http://127.0.0.1:5052
+    python tools/transfer_report.py --health-json /tmp/health.json
+
+    # jax-free replay model: lockstep-replay a trace, price every
+    # planned sub-batch with the shared byte model, and model pubkey
+    # identity (same validators re-sign every epoch) for the re-upload
+    # ratio
+    python tools/transfer_report.py --generate gossip_steady \\
+        --duration 24 --seed 7
+    python tools/transfer_report.py --trace /tmp/flood.jsonl --json
+
+Live mode reads MEASURED numbers (the transfer ledger's counters and
+sliding-window sketch); replay mode derives PREDICTED numbers from the
+scheduler's exact flush policy (`lockstep_replay`) and the analytic
+byte model (`transfer_ledger.operand_bytes_model`, pinned against the
+packer's real `ndarray.nbytes` by test), plus a MODELED re-upload
+ratio: validator identities are assigned deterministically so the same
+position in the same slot-of-epoch re-signs every epoch — the
+gossip-steady identity assumption, stated in the report as
+`reupload_model` so a modeled number can never masquerade as a
+measured one.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPORT_SCHEMA = "lighthouse_tpu.transfer_report/1"
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024.0 or unit == "GiB":
+            return f"{n:,.1f} {unit}" if unit != "B" else f"{n:,.0f} B"
+        n /= 1024.0
+    return f"{n:,.1f} GiB"
+
+
+# ---------------------------------------------------------------------------
+# Replay model (jax-free)
+# ---------------------------------------------------------------------------
+
+
+def modeled_validator_entries(
+    ev: dict,
+    pos_in_slot: int,
+    slot_s: float,
+    slots_per_epoch: int,
+    g1_bytes: int,
+):
+    """Deterministic pubkey identities for one arrival event: the
+    validator at (kind, slot-of-epoch, position-in-slot, lane) is the
+    SAME validator next epoch — the gossip-steady re-sign model. Returns
+    ``(digest, nbytes)`` entries per signature set."""
+    from lighthouse_tpu.utils.transfer_ledger import pubkey_digest
+
+    slot = int(ev["t"] / slot_s) if slot_s > 0 else 0
+    sie = slot % max(1, slots_per_epoch)
+    out = []
+    for j in range(ev["n_sets"]):
+        entries = []
+        for i in range(ev["pubkeys"]):
+            key = f"{ev['kind']}:{sie}:{pos_in_slot + j}:{i}".encode()
+            # THE sketch key function (transfer_ledger.pubkey_digest):
+            # the model must key the same space as the live tracker
+            entries.append((pubkey_digest(key), g1_bytes))
+        out.append(entries)
+    return out
+
+
+def replay_model(
+    events,
+    deadline_ms: float = 25.0,
+    max_batch_sets: int = 256,
+    slot_s: float = 2.0,
+    slots_per_epoch: int = 2,
+    window: int = 1024,
+) -> dict:
+    """Price a trace's data movement without a device: lockstep-replay
+    the flush policy, charge each planned sub-batch the shared byte
+    model at its padded rung (bypasses at their exact rung), and model
+    the pubkey re-upload ratio over the same sliding window the live
+    ledger uses."""
+    from lighthouse_tpu.utils import transfer_ledger as tl
+    from lighthouse_tpu.verification_service import traffic
+    from lighthouse_tpu.verification_service.batcher import round_up_bucket
+
+    report = traffic.lockstep_replay(
+        events, deadline_ms=deadline_ms, max_batch_sets=max_batch_sets
+    )
+
+    per_kind: dict = {}
+    operand_totals: dict = {}
+    padded_total = live_total = 0
+
+    def charge(kinds: str, n_sets: int, rung, live_bytes: int):
+        nonlocal padded_total, live_total
+        ops = tl.operand_bytes_model(*rung)
+        rec = per_kind.setdefault(
+            kinds, {"sets": 0, "dispatches": 0, "est_h2d_bytes": 0,
+                    "est_live_h2d_bytes": 0},
+        )
+        rec["sets"] += n_sets
+        rec["dispatches"] += 1
+        rec["est_h2d_bytes"] += ops["total"]
+        rec["est_live_h2d_bytes"] += live_bytes
+        for op, nb in ops.items():
+            if op != "total":
+                operand_totals[op] = operand_totals.get(op, 0) + nb
+        padded_total += ops["total"]
+        live_total += live_bytes
+
+    for fl in report["flushes"]:
+        for sb in fl["sub_batches"]:
+            charge(
+                sb["kinds"], sb["n_sets"], tuple(sb["rung"]),
+                sb["est_live_h2d_bytes"],
+            )
+    # verify_now bypasses pack their own exact-rung batch on the device
+    for ev in events:
+        if ev.get("path") != "verify_now":
+            continue
+        rung = (
+            round_up_bucket(ev["n_sets"]),
+            round_up_bucket(ev["pubkeys"]),
+            round_up_bucket(ev["messages"]),
+        )
+        live = tl.live_operand_bytes(
+            ev["n_sets"], ev["n_sets"] * ev["pubkeys"], ev["messages"]
+        )["total"]
+        charge(ev["kind"], ev["n_sets"], rung, live)
+
+    # modeled re-upload: same validators re-sign every epoch. One
+    # observation per EVENT (a submission — the closest analogue of the
+    # live ledger's one-observation-per-pack), and CUMULATIVE
+    # whole-trace totals as the headline ratio so the opportunity and
+    # the ceiling share one base (the window ratio rides along for
+    # parity with the live gauge, but a long trace must not let keys
+    # age out of the window before their next epoch and undersize the
+    # ROADMAP-item-2 win)
+    tracker = tl.ReuploadTracker(window=window)
+    slot_pos: dict = {}
+    cum_re = cum_up = 0
+    for ev in sorted(events, key=lambda e: e["t"]):
+        slot = int(ev["t"] / slot_s) if slot_s > 0 else 0
+        pos = slot_pos.get((ev["kind"], slot), 0)
+        slot_pos[(ev["kind"], slot)] = pos + ev["n_sets"]
+        entries = [
+            entry
+            for per_set in modeled_validator_entries(
+                ev, pos, slot_s, slots_per_epoch, tl.G1_POINT_BYTES
+            )
+            for entry in per_set
+        ]
+        re_b, up_b = tracker.observe(ev["kind"], entries)
+        cum_re += re_b
+        cum_up += up_b
+
+    reup = tracker.summary()
+    pubkey_bytes = operand_totals.get("pubkeys", 0)
+    for rec in per_kind.values():
+        rec["bytes_per_set"] = (
+            round(rec["est_h2d_bytes"] / rec["sets"], 1)
+            if rec["sets"] else 0.0
+        )
+    return {
+        "schema": REPORT_SCHEMA,
+        "mode": "replay_model",
+        "n_events": len(events),
+        "n_flushes": len(report["flushes"]),
+        "per_kind": dict(sorted(per_kind.items())),
+        "h2d_bytes_by_operand": dict(sorted(operand_totals.items())),
+        "est_h2d_bytes_total": padded_total,
+        "est_live_h2d_bytes_total": live_total,
+        "padding_bytes_share": (
+            round(1.0 - live_total / padded_total, 4) if padded_total else 0.0
+        ),
+        "pubkey_bytes_share": (
+            round(pubkey_bytes / padded_total, 4) if padded_total else 0.0
+        ),
+        "reupload_model": {
+            "assumption": (
+                "same validator re-signs at the same slot-of-epoch "
+                "position every epoch (gossip steady-state); MODELED, "
+                "not measured"
+            ),
+            "slot_s": slot_s,
+            "slots_per_epoch": slots_per_epoch,
+            "window": window,
+            # headline = whole-trace cumulative (same base as the
+            # ceiling); the window view mirrors the live gauge
+            "ratio": round(cum_re / cum_up, 4) if cum_up else 0.0,
+            "uploaded_bytes": cum_up,
+            "reuploaded_bytes": cum_re,
+            "window_view": reup,
+        },
+        # what a device-resident pubkey table would have saved over this
+        # trace: the re-uploaded G1 bytes (modeled, whole trace), and
+        # the hard ceiling (every pubkey byte, were all keys resident)
+        "dedup_opportunity_bytes": cum_re,
+        "dedup_ceiling_bytes": pubkey_bytes,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Live mode
+# ---------------------------------------------------------------------------
+
+
+def fetch_health(url: str) -> dict:
+    from urllib.request import urlopen
+
+    with urlopen(url.rstrip("/") + "/lighthouse/health", timeout=10) as r:
+        return json.loads(r.read().decode())
+
+
+def live_report(doc: dict) -> dict:
+    """Normalize a /lighthouse/health document (or its ``data`` body)
+    into this tool's report shape."""
+    body = doc.get("data", doc)
+    dm = body.get("data_movement")
+    if dm is None:
+        raise SystemExit(
+            "health document has no data_movement block (node predates "
+            "the transfer ledger, or the block was stripped)"
+        )
+    return {"schema": REPORT_SCHEMA, "mode": "live", **dm}
+
+
+# ---------------------------------------------------------------------------
+# Rendering
+# ---------------------------------------------------------------------------
+
+
+def render(rep: dict) -> str:
+    lines = []
+    w = lines.append
+    if rep["mode"] == "live":
+        w("data movement (measured, live ledger)")
+        w(f"  h2d total: {_fmt_bytes(rep['h2d_bytes_total'])}   "
+          f"d2h total: {_fmt_bytes(rep['d2h_bytes_total'])}")
+        w("  by operand:")
+        for op, nb in rep["h2d_bytes_by_operand"].items():
+            w(f"    {op:<12} {_fmt_bytes(nb):>14}")
+        w("  by kind:")
+        for k, nb in rep["h2d_bytes_by_kind"].items():
+            w(f"    {k:<28} {_fmt_bytes(nb):>14}")
+        share = rep.get("pack_share_of_verify_wall")
+        bw = rep.get("h2d_bandwidth_bytes_per_s")
+        w(f"  pack share of verify wall: "
+          f"{'n/a' if share is None else f'{share * 100:.1f}%'}   "
+          f"effective h2d bandwidth: "
+          f"{'n/a' if bw is None else _fmt_bytes(bw) + '/s'}")
+        reup = rep["pubkey_reupload"]
+        w(f"  pubkey re-upload window: ratio={reup['ratio']} over "
+          f"{reup['records']} verifies "
+          f"({_fmt_bytes(reup['reuploaded_bytes'])} of "
+          f"{_fmt_bytes(reup['uploaded_bytes'])} re-uploaded)")
+        for k, kr in reup.get("kinds", {}).items():
+            w(f"    {k:<28} ratio={kr['ratio']:<7} "
+              f"{_fmt_bytes(kr['reuploaded_bytes'])} re-uploaded")
+        mem = rep.get("device_memory")
+        if mem:
+            w("  device memory: " + "  ".join(
+                f"{k}={_fmt_bytes(v)}" for k, v in sorted(mem.items())
+            ))
+        w("  dedup opportunity (device-resident pubkey table, ROADMAP "
+          "item 2): the re-uploaded share above is reclaimable H2D "
+          "bandwidth")
+        return "\n".join(lines)
+
+    w(f"data movement (replay model, {rep['n_events']} events, "
+      f"{rep['n_flushes']} flushes)")
+    w(f"  est h2d total: {_fmt_bytes(rep['est_h2d_bytes_total'])} "
+      f"(live {_fmt_bytes(rep['est_live_h2d_bytes_total'])}, padding "
+      f"share {rep['padding_bytes_share'] * 100:.1f}%)")
+    w("  by operand:")
+    for op, nb in rep["h2d_bytes_by_operand"].items():
+        w(f"    {op:<12} {_fmt_bytes(nb):>14}")
+    w(f"  {'kind':<28}{'sets':>6}{'dispatches':>11}{'bytes':>14}"
+      f"{'bytes/set':>11}")
+    for kind, rec in rep["per_kind"].items():
+        w(f"  {kind:<28}{rec['sets']:>6}{rec['dispatches']:>11}"
+          f"{_fmt_bytes(rec['est_h2d_bytes']):>14}"
+          f"{rec['bytes_per_set']:>11,.0f}")
+    rm = rep["reupload_model"]
+    w(f"  modeled pubkey re-upload ratio: {rm['ratio']} "
+      f"(window {rm['window']}, epoch = {rm['slots_per_epoch']} x "
+      f"{rm['slot_s']}s slots) — {rm['assumption']}")
+    w(f"  dedup opportunity: {_fmt_bytes(rep['dedup_opportunity_bytes'])} "
+      f"modeled re-uploads; ceiling "
+      f"{_fmt_bytes(rep['dedup_ceiling_bytes'])} "
+      f"({rep['pubkey_bytes_share'] * 100:.1f}% of all h2d bytes is "
+      f"pubkeys)")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    src = ap.add_argument_group("source (exactly one)")
+    src.add_argument("--url", default=None,
+                     help="live node base URL (reads /lighthouse/health)")
+    src.add_argument("--health-json", default=None,
+                     help="saved /lighthouse/health JSON document")
+    src.add_argument("--trace", default=None,
+                     help="arrival-trace JSONL file (replay model)")
+    src.add_argument("--generate", default=None,
+                     help="synthetic generator name (replay model)")
+    gen = ap.add_argument_group("replay model")
+    gen.add_argument("--seed", type=int, default=0)
+    gen.add_argument("--duration", type=float, default=None)
+    gen.add_argument("--rate-scale", type=float, default=1.0)
+    gen.add_argument("--deadline-ms", type=float, default=25.0)
+    gen.add_argument("--max-batch", type=int, default=256)
+    gen.add_argument("--slot-s", type=float, default=2.0,
+                     help="slot length for the identity model")
+    gen.add_argument("--slots-per-epoch", type=int, default=2,
+                     help="epoch length for the identity model (same "
+                     "validators re-sign every epoch)")
+    gen.add_argument("--window", type=int, default=1024,
+                     help="re-upload sketch window (verifies)")
+    out = ap.add_argument_group("output")
+    out.add_argument("--json", action="store_true")
+    out.add_argument("--out", default=None)
+    args = ap.parse_args(argv)
+
+    chosen = [
+        s for s in (args.url, args.health_json, args.trace, args.generate)
+        if s is not None
+    ]
+    if len(chosen) != 1:
+        raise SystemExit(
+            "exactly one of --url / --health-json / --trace / --generate "
+            "is required"
+        )
+
+    if args.url:
+        rep = live_report(fetch_health(args.url))
+    elif args.health_json:
+        with open(args.health_json) as f:
+            rep = live_report(json.load(f))
+    else:
+        from lighthouse_tpu.verification_service import traffic
+
+        if args.trace:
+            _header, events = traffic.read_trace(args.trace)
+        else:
+            gen_fn = traffic.GENERATORS.get(args.generate)
+            if gen_fn is None:
+                raise SystemExit(
+                    f"unknown generator {args.generate!r}; have "
+                    f"{sorted(traffic.GENERATORS)}"
+                )
+            kw = {"seed": args.seed, "rate_scale": args.rate_scale}
+            if args.duration is not None:
+                kw["duration_s"] = args.duration
+            events = gen_fn(**kw)
+        rep = replay_model(
+            events,
+            deadline_ms=args.deadline_ms,
+            max_batch_sets=args.max_batch,
+            slot_s=args.slot_s,
+            slots_per_epoch=args.slots_per_epoch,
+            window=args.window,
+        )
+
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(rep, f, indent=1)
+    if args.json:
+        print(json.dumps(rep))
+    else:
+        print(render(rep))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
