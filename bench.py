@@ -402,6 +402,130 @@ def measure_planner_leg(sets, B, K, M, reps: int = 3):
     }
 
 
+def measure_key_table_leg(sets, B, K, M, reps: int = 3):
+    """Device-resident pubkey table on/off at the headline bucket
+    (ISSUE 10), same repeat-validator traffic both legs: the OFF leg
+    re-packs and re-ships the G1 limb planes every rep (the measured
+    >0.9 re-upload shape the table exists to kill), the ON leg ships a
+    (B, K) index plane and gathers device-side. Both legs dispatch the
+    SAME already-warm staged rung; the ON leg's one new compile is the
+    sub-second gather program, paid in its warm-up rep and pinned by
+    the steady-recompile delta. Per-leg pubkeys bytes/set (the
+    acceptance metric, live operand), pack seconds and sets/s land in
+    the JSON; ``pubkeys_bytes_per_set`` feeds the bench_diff gate."""
+    import types as _types
+
+    import jax
+
+    from lighthouse_tpu.crypto.device import bls as device_bls
+    from lighthouse_tpu.crypto.device import key_table as key_table_mod
+    from lighthouse_tpu.utils import metrics, transfer_ledger
+
+    if not transfer_ledger.enabled():
+        return {"skipped": "transfer ledger disabled"}
+
+    n = len(sets)
+
+    def _pubkeys_bytes():
+        doc = transfer_ledger.summary()
+        return doc.get("h2d_bytes_by_operand", {}).get("pubkeys", 0)
+
+    def _pack_total_s():
+        doc = transfer_ledger.summary()
+        return doc.get("pack_seconds", {}).get("total", {}).get("sum_s", 0.0)
+
+    def _recompiles() -> float:
+        m = metrics.get("bls_device_recompiles_total")
+        return sum(c.value for c in m.children().values()) if m else 0.0
+
+    def _measure(run_once):
+        # warm-up (compiles land here); -O-safe — an assert would strip
+        # the warm-up itself and bill the first timed rep for the compile
+        if run_once() is not True:
+            raise RuntimeError("key-table leg warm-up batch must verify")
+        rec0 = _recompiles()
+        pk0, pack0 = _pubkeys_bytes(), _pack_total_s()
+        samples = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            run_once()
+            samples.append(time.perf_counter() - t0)
+        med, spread = _median_spread(samples)
+        return {
+            "sets_per_sec": round(n / med, 2),
+            "rep_spread": round(spread, 3),
+            "pubkeys_bytes_per_set": round(
+                (_pubkeys_bytes() - pk0) / (reps * n), 1
+            ),
+            "pack_s_per_batch": round((_pack_total_s() - pack0) / reps, 4),
+            "steady_recompiles": _recompiles() - rec0,
+        }
+
+    def run_off():
+        args = device_bls.pack_signature_sets_raw(
+            sets, pad_b=B, pad_k=K, pad_m=M
+        )
+        return bool(
+            jax.block_until_ready(device_bls.verify_batch_raw_staged(*args))
+        )
+
+    off = _measure(run_off)
+
+    # the table mirrors exactly this workload's distinct points (the
+    # bench's stand-in for the node's ValidatorPubkeyCache; same
+    # identity-map contract: the wrappers pin the very point objects
+    # the sets carry)
+    points, seen = [], set()
+    for _sig, pks, _m in sets:
+        for p in pks:
+            if id(p) not in seen:
+                seen.add(id(p))
+                points.append(p)
+    cache = _types.SimpleNamespace(
+        pubkeys=[_types.SimpleNamespace(point=p) for p in points]
+    )
+    table = key_table_mod.DeviceKeyTable(cache)
+    table.sync(reason="startup")
+    key_table_mod.set_table(table)
+    try:
+
+        def run_on():
+            res = table.resolve_sets(sets)
+            if res is None:
+                raise RuntimeError("bench sets must be table-resident")
+            resolved, dev, agg, collapsed = res
+            # the bench dispatches directly (no backend), so it commits
+            # the shipping-path accounting the hit-ratio reads
+            table.count_shipped(len(sets) - collapsed, collapsed)
+            args = device_bls.pack_signature_sets_indexed(
+                sets, resolved, pad_b=B, pad_k=K, pad_m=M
+            )
+            return bool(
+                jax.block_until_ready(
+                    device_bls.verify_batch_raw_staged_gather(dev, agg, *args)
+                )
+            )
+
+        on = _measure(run_on)
+    finally:
+        key_table_mod.clear_table(table)
+    st = table.status()
+    on["hit_ratio"] = st["hit_ratio"]
+    on["collapsed_sets"] = st["sets"]["collapsed"]
+    on["aggregate_rows"] = st["aggregates_resident"]
+    off_b, on_b = off["pubkeys_bytes_per_set"], on["pubkeys_bytes_per_set"]
+    return {
+        "B": B, "K": K, "M": M, "n_sets": n, "reps": reps,
+        "off": off,
+        "on": on,
+        "pubkeys_bytes_per_set_reduction": (
+            round(1.0 - on_b / off_b, 4) if off_b else None
+        ),
+        "table_validators": st["validators_resident"],
+        "table_upload_bytes": st["upload_bytes"],
+    }
+
+
 def measure_replay_leg(
     use_cpu: bool,
     generator: str = "epoch_boundary_flood",
@@ -651,6 +775,12 @@ def _data_movement_block(before, after, n_sets, n_packs, step_s) -> dict:
         "pack_share_of_verify_wall": (
             round((pack_s / n_packs) / step_s, 4) if step_s > 0 else None
         ),
+        # the acceptance metric of the device key table (ISSUE 10): live
+        # G1 bytes shipped per set — the key_table_leg measures its
+        # on-table counterpart
+        "pubkeys_bytes_per_set": (
+            round(ops.get("pubkeys", 0) / denom, 1) if measured else None
+        ),
         "pubkey_reupload_ratio": reup.get("ratio") if measured else None,
         "pubkey_reupload_window": reup.get("records") if measured else None,
         "device_memory": after.get("device_memory"),
@@ -791,6 +921,18 @@ def main() -> None:
         except Exception as e:  # the leg must not kill the line
             planner_leg = {"error": str(e)[:200]}
 
+    # Device key table on/off at the headline bucket (ISSUE 10): the
+    # pubkey-plane bytes/set drop and pack-time delta under the same
+    # repeat-validator traffic. The staged rung is already warm; the ON
+    # leg adds only the sub-second gather compile (warm-up rep).
+    if _budget_left() < 240:
+        key_table_leg = {"skipped": "budget"}
+    else:
+        try:
+            key_table_leg = measure_key_table_leg(sets, B_PAD, K_PAD, M_PAD)
+        except Exception as e:  # the leg must not kill the line
+            key_table_leg = {"error": str(e)[:200]}
+
     # Mainnet-shaped replay (ISSUE 7): per-class p50/p99 verdict latency
     # under the epoch-boundary flood — the arrival model the SLO layer
     # certifies, folded into the trajectory. Subprocess, budget-guarded.
@@ -885,6 +1027,7 @@ def main() -> None:
                 "data_movement": data_movement,
                 "scheduler_leg": scheduler_leg,
                 "planner_leg": planner_leg,
+                "key_table_leg": key_table_leg,
                 "replay_leg": replay_leg,
                 "startup": startup,
                 "buckets": buckets,

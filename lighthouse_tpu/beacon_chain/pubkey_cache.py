@@ -29,8 +29,28 @@ class ValidatorPubkeyCache:
         self.pubkeys: list[bls.PublicKey] = []
         self.indices: dict[bytes, int] = {}  # compressed bytes -> index
         self.store = store
+        # admission listeners (ISSUE 10): the device-resident pubkey
+        # table subscribes so deposits delta-sync host→device without
+        # the cache importing the device stack
+        self._listeners: list = []
         if store is not None:
             self._load()
+
+    def subscribe(self, fn) -> None:
+        """Call ``fn(cache)`` after every successful admission batch.
+        Listener failures are contained (logged, never raised): a device
+        mirror that cannot sync degrades that mirror — new indices fall
+        back to the raw pack path — and must not fail block import."""
+        self._listeners.append(fn)
+
+    def unsubscribe(self, fn) -> None:
+        """Remove a listener (no-op when absent): a stopped client must
+        detach its device mirror or admissions would keep syncing — and
+        keeping alive — a table nothing routes to anymore."""
+        try:
+            self._listeners.remove(fn)
+        except ValueError:
+            pass
 
     def _load(self) -> None:
         rows = sorted(
@@ -61,6 +81,17 @@ class ValidatorPubkeyCache:
             batch.append((Column.PUBKEY_CACHE, struct.pack("<Q", idx), raw))
         if self.store is not None and batch:
             self.store.kv.put_batch(batch)
+        if batch:
+            for fn in list(self._listeners):
+                try:
+                    fn(self)
+                except Exception as e:
+                    from ..utils import logging as tlog
+
+                    tlog.log(
+                        "warn", "pubkey-cache admission listener failed",
+                        error=repr(e)[:120],
+                    )
 
     def get(self, validator_index: int) -> bls.PublicKey:
         try:

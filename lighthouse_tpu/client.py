@@ -60,6 +60,16 @@ class ClientConfig:
     compile_service: bool = True
     compile_cache_dir: Optional[str] = None
     compile_rungs: tuple = ()
+    # device-resident validator pubkey table (crypto/device/key_table.py,
+    # ISSUE 10): uploaded once from the chain's ValidatorPubkeyCache,
+    # delta-synced on deposit admission; signature sets whose keys are
+    # resident ship (B, K) indices instead of G1 limb planes. Only
+    # effective with bls_backend="tpu"; LIGHTHOUSE_TPU_KEY_TABLE=0
+    # disables at the env level.
+    device_key_table: bool = True
+    # None = LIGHTHOUSE_TPU_KEY_TABLE_MAX_AGG env (default 4096); 0
+    # disables the aggregate-sum region
+    key_table_max_aggregates: Optional[int] = None
 
 
 class Client:
@@ -103,6 +113,20 @@ class Client:
 
                 csvc.stop()
                 clear_service(csvc)
+            ktable = getattr(self.chain, "device_key_table", None)
+            if ktable is not None:
+                # after the drain too: a draining flush may still pack
+                # against the table. Detach only OUR table — a racing
+                # rebuild must not lose its fresh one — and drop the
+                # admission listener so the cache stops syncing (and
+                # keeping alive) a table nothing routes to.
+                from .crypto.device import key_table as _key_table
+
+                _key_table.clear_table(ktable)
+                listener = getattr(self.chain, "_key_table_listener", None)
+                if listener is not None:
+                    self.chain.pubkey_cache.unsubscribe(listener)
+                    self.chain._key_table_listener = None
             self.processor.shutdown()
             self.persist()
             if self.monitoring is not None:
@@ -335,6 +359,38 @@ class ClientBuilder:
             from .ssz import hash_tree_root as _htr
 
             store.put_block(_htr(cp_block.message), cp_block)
+
+        ktable = None
+        if cfg.bls_backend == "tpu" and cfg.device_key_table:
+            from .crypto.device import key_table as _key_table
+
+            if _key_table.env_enabled():
+                try:
+                    # one upload at startup mirrors the loaded cache
+                    # (restart-from-store included); import_new_pubkeys
+                    # admissions delta-sync through the subscription
+                    ktable = _key_table.DeviceKeyTable(
+                        chain.pubkey_cache,
+                        max_aggregates=cfg.key_table_max_aggregates,
+                    )
+                    ktable.sync(reason="startup")
+                    _key_table.set_table(ktable)
+                    listener = (
+                        lambda _cache, _t=ktable: _t.sync(reason="delta")
+                    )
+                    chain.pubkey_cache.subscribe(listener)
+                    # stop() must be able to detach it, or admissions
+                    # would keep a dead client's table alive + syncing
+                    chain._key_table_listener = listener
+                except Exception as e:
+                    from .utils import logging as tlog
+
+                    tlog.log(
+                        "warn", "device key table unavailable",
+                        error=repr(e)[:120],
+                    )
+                    ktable = None
+        chain.device_key_table = ktable
 
         csvc = None
         if cfg.bls_backend == "tpu" and cfg.compile_service:

@@ -164,6 +164,34 @@ def staged_instruction_counts(B: int, K: int, M: int) -> dict:
     return out
 
 
+def warm_gather(B: int, K: int, table) -> dict:
+    """Warm the device key-table gather program (ISSUE 10) for rung
+    (B, K) against ``table``'s CURRENT device array — the gathered
+    variant of the rung, keyed on the table's capacity rung
+    (key_table.CAPACITY_LADDER). Dispatched through ``bls._run_stage``
+    (stage label "gather") like the staged programs, so the recompile
+    counter and the stage histogram see exactly what gathered traffic
+    sees. Sub-second on every backend (one take + reshape); not
+    manifested — a restart re-warms it in-process."""
+    import jax.numpy as jnp
+
+    from ..crypto.device import bls as dbls
+
+    dev, agg = table.device_arrays()
+    if dev is None:
+        raise StageWarmupError(
+            "gather", {}, RuntimeError("key table has no device array")
+        )
+    idx = jnp.zeros((B, K), jnp.int32)
+    try:
+        _, elapsed, fresh = dbls._run_stage(
+            "gather", dbls._gather, dev, agg, idx
+        )
+    except Exception as e:
+        raise StageWarmupError("gather", {}, e)
+    return {"seconds": elapsed, "fresh": fresh}
+
+
 def warm_staged(B: int, K: int, M: int) -> dict:
     """Warm the staged pipeline at rung (B, K, M) under the ACTIVE fp
     impl: dispatch each module-level jitted stage on zero-filled dummy

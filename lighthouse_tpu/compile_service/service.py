@@ -655,6 +655,27 @@ class CompileService:
             _COMPILE_SECONDS.with_labels(stage).observe(
                 float(rec.get("seconds", 0.0))
             )
+        # gathered variant (ISSUE 10): with a device key table attached,
+        # this rung's traffic dispatches the "gather" program ahead of
+        # stage 2 — warm it alongside so the first static batch at the
+        # rung pays zero fresh compiles. Sub-second; a failure degrades
+        # the gathered variant only (the raw rung above is already warm)
+        # and must not fail the rung.
+        if self._compile_rung_fn is None:
+            try:
+                from ..crypto.device import key_table as _kt
+
+                tbl = _kt.get_active_table()
+                if tbl is not None:
+                    from . import lowering
+
+                    grec = lowering.warm_gather(b, k, tbl)
+                    _COMPILES.with_labels("gather", "ok").inc()
+                    _COMPILE_SECONDS.with_labels("gather").observe(
+                        float(grec.get("seconds", 0.0))
+                    )
+            except Exception:
+                _COMPILES.with_labels("gather", "error").inc()
         # manifest honesty: a FRESH compile that left no new executable
         # in the cache dir must not add manifest entries — the manifest
         # stays at least as conservative as the cache

@@ -242,33 +242,55 @@ class SecretKey:
 
 class SignatureSet:
     """A signature over one message by one or more public keys — the unit
-    of batch verification (reference: generic_signature_set.rs:61-107)."""
+    of batch verification (reference: generic_signature_set.rs:61-107).
 
-    __slots__ = ("signature", "signing_keys", "message")
+    ``signing_indices`` optionally carries the validator indices the
+    keys were resolved at (state_transition/signature_sets.py threads
+    them): the device key table's flush-planner classification uses
+    them as a fast static/dynamic pre-filter
+    (crypto/device/key_table.py). They are advisory — the backend's
+    index resolution is identity-pinned to the host pubkey cache's own
+    point objects, so a stale or foreign index can cost a raw-plane
+    fallback but never a wrong-key verification."""
+
+    __slots__ = ("signature", "signing_keys", "message", "signing_indices")
 
     def __init__(
         self,
         signature: Signature,
         signing_keys: Sequence[PublicKey],
         message: bytes,
+        signing_indices: "Optional[Sequence[int]]" = None,
     ):
         if len(message) != 32:
             raise BlsError("message must be a 32-byte signing root")
         self.signature = signature
         self.signing_keys = list(signing_keys)
         self.message = bytes(message)
+        if signing_indices is not None:
+            signing_indices = [int(i) for i in signing_indices]
+            if len(signing_indices) != len(self.signing_keys):
+                raise BlsError(
+                    "signing_indices must match signing_keys one-to-one"
+                )
+        self.signing_indices = signing_indices
 
     @classmethod
     def single_pubkey(
-        cls, signature: Signature, signing_key: PublicKey, message: bytes
+        cls, signature: Signature, signing_key: PublicKey, message: bytes,
+        signing_index: "Optional[int]" = None,
     ) -> "SignatureSet":
-        return cls(signature, [signing_key], message)
+        return cls(
+            signature, [signing_key], message,
+            None if signing_index is None else [signing_index],
+        )
 
     @classmethod
     def multiple_pubkeys(
-        cls, signature: Signature, signing_keys: Sequence[PublicKey], message: bytes
+        cls, signature: Signature, signing_keys: Sequence[PublicKey],
+        message: bytes, signing_indices: "Optional[Sequence[int]]" = None,
     ) -> "SignatureSet":
-        return cls(signature, signing_keys, message)
+        return cls(signature, signing_keys, message, signing_indices)
 
     def verify(self) -> bool:
         """Verify just this set (fast_aggregate_verify)."""

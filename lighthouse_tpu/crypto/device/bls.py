@@ -367,9 +367,45 @@ def _stage3_fn(pk_x, pk_y, pk_inf, msg_aff_x, msg_aff_y, msg_aff_inf,
     )
 
 
+def _gather_fn(table, agg, pk_idx):
+    """Device-side pubkey gather (ISSUE 10): the static packer ships a
+    ``(B, K)`` int32 index plane and this stage materializes the
+    ``[B, K, 2, NL]`` limb planes from the device-resident key table —
+    the pack's dominant operand (87–94% of H2D bytes at committee
+    rungs, COST_MODEL.md) never crosses the host-device boundary again.
+    Indices below ``table.shape[0]`` address the validator mirror;
+    indices at/above it address the small aggregate-sum region ``agg``
+    (cached epoch-stable committee sums, key_table.py) — two clipped
+    takes and a select, so the regions stay separate device arrays and
+    an aggregate insert never copies the big table.
+
+    Runs as its own staged program ("gather", through ``_run_stage``)
+    ahead of stage 2 rather than fused into stage 1's ~31k-HLO body:
+    the table argument keys the compile on the table CAPACITY rung
+    (key_table.CAPACITY_LADDER), and a table-growth recompile of this
+    one-op program is sub-second while a stage-1 variant would re-pay a
+    multi-minute XLA compile per capacity step. The gathered output
+    feeds the UNCHANGED stage-2 program, so every warm stage-1/2/3 rung
+    stays warm across table growth. Masked lanes gather row 0 — a REAL
+    key's coordinates, unlike the raw packer's zero-filled padding rows
+    — which is safe only because stage 2's ``from_affine(..., ~pk_mask)``
+    forces masked lanes to infinity regardless of coordinates; nothing
+    may come to rely on masked gather lanes holding invalid points."""
+    B, K = pk_idx.shape
+    flat_idx = pk_idx.reshape(-1)
+    base = table.shape[0]
+    from_val = jnp.take(table, jnp.clip(flat_idx, 0, base - 1), axis=0)
+    from_agg = jnp.take(
+        agg, jnp.clip(flat_idx - base, 0, agg.shape[0] - 1), axis=0
+    )
+    rows = jnp.where((flat_idx < base)[:, None, None], from_val, from_agg)
+    return rows.reshape(B, K, *table.shape[1:])
+
+
 _stage1 = jax.jit(_stage1_fn)
 _stage2 = jax.jit(_stage2_fn)
 _stage3 = jax.jit(_stage3_fn)
+_gather = jax.jit(_gather_fn)
 
 
 # ---------------------------------------------------------------------------
@@ -557,6 +593,39 @@ def verify_batch_raw_staged(
     (batch geometry, fp_impl, per-stage dispatch-to-sync seconds,
     verdict, recompile flag); a False verdict triggers
     ``dump_on_failure`` so the surrounding context is preserved."""
+    return _staged_verify(
+        pk_xy, pk_mask, sig_x, sig_larger, msg_u, msg_idx, rand_bits,
+        set_mask,
+    )
+
+
+def verify_batch_raw_staged_gather(
+    table, agg, pk_idx, pk_mask, sig_x, sig_larger, msg_u, msg_idx,
+    rand_bits, set_mask,
+):
+    """Gathered variant of :func:`verify_batch_raw_staged` (ISSUE 10):
+    the pubkey planes arrive as a ``(B, K)`` index plane into the
+    device-resident key ``table`` (+ aggregate region ``agg``) and are
+    materialized by the "gather" staged program; stages 1–3 are
+    byte-identical to the raw path, so the verdict is too. The
+    ``bls_stage_verify`` journal row carries the extra
+    ``gather_s``/``gathered`` attribution."""
+    try:
+        pk_xy, sg, fg = _run_stage("gather", _gather, table, agg, pk_idx)
+    except BaseException:
+        # mirror the staged raise contract: the pack's ledger row lands
+        transfer_ledger.commit_verify(None, d2h_bytes=0)
+        raise
+    return _staged_verify(
+        pk_xy, pk_mask, sig_x, sig_larger, msg_u, msg_idx, rand_bits,
+        set_mask, gather_record=(sg, fg),
+    )
+
+
+def _staged_verify(
+    pk_xy, pk_mask, sig_x, sig_larger, msg_u, msg_idx, rand_bits, set_mask,
+    gather_record=None,
+):
     try:
         (sig_xy, mx, my, minf, sig_ok), s1, f1 = _run_stage(
             "stage1", _stage1, sig_x, sig_larger, msg_u
@@ -588,10 +657,16 @@ def verify_batch_raw_staged(
         "m": int(msg_u.shape[0]),
         "fp_impl": fp.get_impl(),
     }
+    gather_fields = {}
+    recompiled = bool(f1 or f2 or f3)
+    if gather_record is not None:
+        sg, fg = gather_record
+        gather_fields = {"gathered": True, "gather_s": round(sg, 6)}
+        recompiled = recompiled or bool(fg)
     flight_recorder.record(
         "bls_stage_verify",
         stage1_s=round(s1, 6), stage2_s=round(s2, 6), stage3_s=round(s3, 6),
-        recompiled=bool(f1 or f2 or f3), verdict=verdict, **geometry,
+        recompiled=recompiled, verdict=verdict, **gather_fields, **geometry,
     )
     # the data-movement row this thread's pack staged (transfer_ledger):
     # the verdict read is the only device→host transfer of a staged
@@ -654,6 +729,35 @@ def _pack_common(sets, B: int, K: int):
         gxy, _ = curve.pack_g2([g2_generator()])
         sig_xy[len(sets):] = gxy[0]
     return pk_xy, pk_mask, sig_xy, rand, set_mask
+
+
+def _pad_sig_lanes(sig_x, n_live: int) -> None:
+    """Padding lanes get the G2 generator's x (a valid curve x) so the
+    device decompression stays uniform; their result is masked out by
+    ``set_mask``. ONE definition for both halves of the static/dynamic
+    packer split — the two packers must stay byte-identical in every
+    non-pubkey plane."""
+    if sig_x.shape[0] <= n_live:
+        return
+    from ..cpu.curve import g2_generator
+
+    g = g2_generator()
+    sig_x[n_live:, 0] = fp.int_to_limbs(g.x.c0.n)
+    sig_x[n_live:, 1] = fp.int_to_limbs(g.x.c1.n)
+
+
+def _pack_message_planes(sets, B: int, pad_m: int | None):
+    """Shared message half of the raw/indexed packers: dedup + padded
+    per-lane index plane + hash_to_field u-values. Returns
+    ``(msg_u, msg_idx, m_req)``."""
+    msgs, idx = _dedup_messages([m for _, _, m in sets], pad_m)
+    m_req = int(idx.max()) + 1 if len(idx) else 1  # distinct live messages
+    msg_idx = np.zeros((B,), np.int32)
+    msg_idx[: len(sets)] = idx
+    from . import htc
+
+    msg_u = htc.messages_to_u(msgs, DST)
+    return msg_u, msg_idx, m_req
 
 
 def _dedup_messages(messages, pad_m: int | None):
@@ -742,6 +846,13 @@ def pack_signature_sets_raw(
     ``(Signature-object, [pk_points], message)`` triples. Signatures stay
     COMPRESSED — only byte parsing happens here; no host sqrt.
 
+    DYNAMIC half of the static/dynamic packer split (ISSUE 10): this
+    packer ships full G1 limb planes and serves out-of-table keys (VC
+    tests, library callers, pre-admission gossip); sets whose pubkeys
+    all resolve to device key-table indices go through
+    :func:`pack_signature_sets_indexed` instead and ship a ``(B, K)``
+    index plane (docs/DEVICE_CRYPTO.md).
+
     Instrumented as the data-movement ledger's measured pack (ISSUE 8):
     phases ``decode`` (signature byte parsing + randomness),
     ``limb_split`` (int→limb conversion + array fill), ``pad``
@@ -789,25 +900,12 @@ def pack_signature_sets_raw(
         if ledger_on:
             for j in range(len(pks)):
                 pk_blobs.append(xy[j].tobytes())
-    if B > len(sets):
-        # padding lanes: the generator's x (a valid curve x) keeps the
-        # decompression uniform; their result is masked out
-        t0 = time.perf_counter()
-        from ..cpu.curve import g2_generator
-
-        g = g2_generator()
-        sig_x[len(sets):, 0] = fp.int_to_limbs(g.x.c0.n)
-        sig_x[len(sets):, 1] = fp.int_to_limbs(g.x.c1.n)
-        t_pad += time.perf_counter() - t0
+    t0 = time.perf_counter()
+    _pad_sig_lanes(sig_x, len(sets))
+    t_pad += time.perf_counter() - t0
 
     t0 = time.perf_counter()
-    msgs, idx = _dedup_messages([m for _, _, m in sets], pad_m)
-    m_req = int(idx.max()) + 1 if len(idx) else 1  # distinct live messages
-    msg_idx = np.zeros((B,), np.int32)
-    msg_idx[: len(sets)] = idx
-    from . import htc
-
-    msg_u = htc.messages_to_u(msgs, DST)
+    msg_u, msg_idx, m_req = _pack_message_planes(sets, B, pad_m)
     t_hash = time.perf_counter() - t0
 
     t0 = time.perf_counter()
@@ -857,6 +955,118 @@ def pack_signature_sets_raw(
     return args
 
 
+def pack_signature_sets_indexed(
+    sets, indices, pad_b: int | None = None, pad_k: int | None = None,
+    pad_m: int | None = None,
+):
+    """STATIC half of the raw packer split (ISSUE 10): for sets whose
+    pubkeys all resolved to device key-table indices
+    (``key_table.DeviceKeyTable.resolve_sets``), ship a ``(B, K)`` int32
+    index plane + mask instead of the ``(B, K, 2, NL)`` G1 limb planes —
+    ~5 bytes per pubkey slot instead of 257. ``indices`` is the per-set
+    index list (aggregate-collapsed sets carry one index). Everything
+    else (signature decode, randomness, message hashing) matches
+    :func:`pack_signature_sets_raw`, and the ledger row is labeled
+    ``indexed`` so byte accounting stays honest."""
+    t_start = time.perf_counter()
+    sets = list(sets)
+    indices = list(indices)
+    if len(indices) != len(sets):
+        # a REAL raise, not an assert: under python -O a silent zip
+        # truncation would leave trailing sets masked out — an
+        # unverified signature accepted by a True batch verdict
+        raise ValueError(
+            f"indices must match sets one-to-one "
+            f"({len(indices)} vs {len(sets)})"
+        )
+    B = pad_b or _round_up(len(sets))
+    K = pad_k or _round_up(max((len(ix) for ix in indices), default=1))
+
+    pk_idx = np.zeros((B, K), np.int32)
+    pk_mask = np.zeros((B, K), bool)
+    sig_x = np.zeros((B, 2, fp.NL), np.int32)
+    sig_larger = np.zeros((B,), bool)
+    rand = np.zeros((B, 2), np.int32)
+    set_mask = np.zeros((B,), bool)
+    t_pad = time.perf_counter() - t_start
+
+    from .. import bls as _bls
+
+    ledger_on = transfer_ledger.enabled()
+    t_decode = t_fill = 0.0
+    pk_slots = 0
+    for i, ((sig, _pks, _msg), ix) in enumerate(zip(sets, indices)):
+        t0 = time.perf_counter()
+        x0, x1, larger = _bls.parse_compressed_g2_x(sig.serialize())
+        hi, lo = _rand_scalar_words()
+        t1 = time.perf_counter()
+        t_decode += t1 - t0
+        pk_idx[i, : len(ix)] = ix
+        pk_mask[i, : len(ix)] = True
+        sig_x[i, 0] = fp.int_to_limbs(x0)
+        sig_x[i, 1] = fp.int_to_limbs(x1)
+        sig_larger[i] = larger
+        rand[i] = (np.int32(np.uint32(hi)), np.int32(np.uint32(lo)))
+        set_mask[i] = True
+        t_fill += time.perf_counter() - t1
+        pk_slots += len(ix)
+    t0 = time.perf_counter()
+    _pad_sig_lanes(sig_x, len(sets))
+    t_pad += time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    msg_u, msg_idx, m_req = _pack_message_planes(sets, B, pad_m)
+    t_hash = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    args = (
+        jnp.asarray(pk_idx),
+        jnp.asarray(pk_mask),
+        jnp.asarray(sig_x),
+        jnp.asarray(sig_larger),
+        jnp.asarray(msg_u),
+        jnp.asarray(msg_idx),
+        jnp.asarray(rand),
+        jnp.asarray(set_mask),
+    )
+    if ledger_on:
+        # same sync rationale as the raw packer: measure the TRANSFER
+        jax.block_until_ready(args)
+    t_dput = time.perf_counter() - t0
+
+    phases = {
+        "decode": t_decode, "limb_split": t_fill, "pad": t_pad,
+        "hash": t_hash, "device_put": t_dput,
+    }
+    total_s = time.perf_counter() - t_start
+    transfer_ledger.observe_pack_phases(phases, total_s)
+    transfer_ledger.note_pack(
+        n_sets=len(sets), b=B, k=K, m=int(msg_u.shape[0]),
+        pk_slots=pk_slots, m_req=m_req,
+        phases=phases,
+        total_s=total_s,
+        operand_nbytes={
+            # the index plane IS the pubkey operand now
+            "pubkeys": pk_idx.nbytes + pk_mask.nbytes,
+            "signatures": sig_x.nbytes + sig_larger.nbytes,
+            "messages": msg_u.nbytes + msg_idx.nbytes,
+            "aux": rand.nbytes + set_mask.nbytes,
+        },
+        pubkey_blobs=(),  # nothing G1-shaped crossed the boundary
+        indexed=True,
+    )
+    return args
+
+
+def _active_key_table():
+    """The process-global device key table when one is attached with
+    resident rows (crypto/device/key_table.py). Lazy import mirrors
+    ``_active_compile_service``."""
+    from . import key_table as _kt
+
+    return _kt.get_active_table()
+
+
 class TpuBackend:
     """Runtime backend ``"tpu"`` (see crypto/backend.py). Presents the same
     protocol as the CPU oracle backend; internally packs fixed-shape
@@ -895,10 +1105,38 @@ class TpuBackend:
                 return False
         path = "raw_staged" if raw_mode else "hashed"
         impl = fp.get_impl()
+        # static/dynamic packer decision (ISSUE 10): when a device key
+        # table is attached and EVERY pubkey of this batch resolves to a
+        # resident index (identity-pinned to the host cache), the pack
+        # ships a (B, K) index plane and the pubkey planes materialize
+        # by device gather. Any out-of-table key (VC tests, library
+        # callers, pre-admission gossip) falls the whole batch back to
+        # the raw limb plane — the flush planner splits mixed flushes
+        # into static/dynamic sub-batches upstream so one raw set does
+        # not degrade a warm static batch.
+        resolved = table_dev = agg_dev = None
+        n_collapsed = 0
+        table = _active_key_table()
+        if raw_mode:
+            if table is not None:
+                res = table.resolve_sets(sets)
+                if res is not None:
+                    resolved, table_dev, agg_dev, n_collapsed = res
+                    path = "raw_gather"
+        elif table is not None:
+            # hashed mode (bare points) can never gather: keep the hit
+            # ratio's denominator honest about the fallback
+            table.count_raw(len(sets))
         # requested geometry, computed ONCE for warm-shape routing and
         # the padding accounting (the packer's own dedup still runs — it
-        # needs the index mapping, not just the count)
-        k_req = max(len(pks) for _, pks, _ in sets)
+        # needs the index mapping, not just the count). The static path
+        # pays the COLLAPSED K axis (a cached aggregate sum is one slot).
+        if resolved is not None:
+            k_req = max(len(ix) for ix in resolved)
+            pk_slots = sum(len(ix) for ix in resolved)
+        else:
+            k_req = max(len(pks) for _, pks, _ in sets)
+            pk_slots = None
         m_req = len({bytes(m) for _, _, m in sets})
         # warm-shape routing (compile_service): when a service is
         # attached and a warm rung covers this batch, pad UP to it so
@@ -911,14 +1149,32 @@ class TpuBackend:
             # epoch BEFORE dispatch: if reset_compiled_state() lands while
             # we verify, the organic mark below must be rejected as stale
             warm_epoch = svc.registry.epoch
+            # NOTE on collapse vs routing: aggregate collapse only
+            # SHRINKS k_req, and warm coverage is >=-monotone in K
+            # (planner.best_covering_rung filters K >= k_req), so the
+            # collapsed request routes at least as warm as the
+            # uncollapsed geometry decide_flush approved — collapse can
+            # never turn a warm-approved flush into a cold stall
             rung = svc.pads_for(len(sets), k_req, m_req)
             if rung is not None:
                 pad_b, pad_k, pad_m = rung
+        if resolved is not None:
+            # the shipping-path accounting the health hit-ratio reads —
+            # committed by the dispatcher, in one place, once the batch
+            # is definitely taking the indexed path
+            table.count_shipped(len(sets) - n_collapsed, n_collapsed)
         with tracing.span(
             "bls.verify_signature_sets", path=path, n_sets=len(sets)
         ) as sp, _VERIFY_SECONDS.with_labels(path, impl).time():
             with tracing.span("bls.pack"):
-                if raw_mode:
+                if resolved is not None:
+                    # static packer: index plane only (the pubkey limbs
+                    # are already device-resident)
+                    args = pack_signature_sets_indexed(
+                        sets, resolved,
+                        pad_b=pad_b, pad_k=pad_k, pad_m=pad_m,
+                    )
+                elif raw_mode:
                     # the raw packer observes its own phase-labeled pack
                     # times (incl. total) into the data-movement ledger
                     args = pack_signature_sets_raw(
@@ -927,8 +1183,14 @@ class TpuBackend:
                 else:
                     with _PACK_TOTAL.time():
                         args = pack_signature_sets_hashed(sets)
-            self._record_geometry(sets, args, k_req=k_req, m_req=m_req)
-            if raw_mode:
+            self._record_geometry(
+                sets, args, k_req=k_req, m_req=m_req, pk_slots=pk_slots
+            )
+            if resolved is not None:
+                out = bool(
+                    verify_batch_raw_staged_gather(table_dev, agg_dev, *args)
+                )
+            elif raw_mode:
                 out = bool(verify_batch_raw_staged(*args))
             else:
                 out = bool(verify_batch_hashed(*args))
@@ -949,14 +1211,17 @@ class TpuBackend:
 
     @staticmethod
     def _record_geometry(
-        sets, packed_args, k_req: int | None = None, m_req: int | None = None
+        sets, packed_args, k_req: int | None = None, m_req: int | None = None,
+        pk_slots: int | None = None,
     ) -> None:
         """Batch-geometry accounting: requested vs padded B/K/M lanes and
         the padding-waste fraction of the pubkey plane (the device pays
         for padded lanes; the caller only needed the requested ones).
         ``k_req``/``m_req`` take the caller's already-computed request
-        geometry so the message set is not hashed twice per batch."""
-        pk_xy = packed_args[0]
+        geometry so the message set is not hashed twice per batch;
+        ``pk_slots`` overrides the live slot count for the indexed path,
+        where an aggregate-collapsed committee set occupies ONE lane."""
+        pk_xy = packed_args[0]  # raw: [B,K,2,NL]; indexed: idx plane [B,K]
         b_pad, k_pad = int(pk_xy.shape[0]), int(pk_xy.shape[1])
         # raw/hashed packers put msg_u [M, 2, 2, NL] at index 4/3
         m_pad = int(packed_args[4 if len(packed_args) == 8 else 3].shape[0])
@@ -970,7 +1235,11 @@ class TpuBackend:
         ):
             _LANES.with_labels(dim, "requested").inc(req)
             _LANES.with_labels(dim, "padded").inc(pad)
-        real_slots = sum(len(pks) for _, pks, _ in sets)
+        real_slots = (
+            pk_slots
+            if pk_slots is not None
+            else sum(len(pks) for _, pks, _ in sets)
+        )
         # ONE waste definition across the stack (lazy import: the
         # planner module is jax-free, but this module must not pull the
         # verification_service package in at import time)

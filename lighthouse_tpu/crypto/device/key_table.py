@@ -1,0 +1,674 @@
+"""Device-resident validator pubkey table (ISSUE 10, ROADMAP item 2).
+
+PR 8's data-movement ledger measured the claim this module acts on: G1
+pubkeys are 87–94% of all host→device bytes at committee rungs
+(COST_MODEL.md bytes-per-set table) and ``bls_device_pubkey_reupload_
+ratio`` sits above 0.9 on gossip steady state — every verify re-packs
+and re-ships the same ~known validators. The FPGA verification-engine
+paper (PAPERS.md, arxiv 2112.02229) keeps precomputed keys resident
+next to the verifier core; this is that pattern for the JAX device
+backend:
+
+* **One device array, index-keyed** — limb-packed G1 affine rows
+  (``int32[cap, 2, NL]``, the exact layout ``curve.pack_g1`` produces
+  and ``_stage2_fn`` consumes) uploaded ONCE from the host
+  :class:`~lighthouse_tpu.beacon_chain.pubkey_cache.ValidatorPubkeyCache`
+  and delta-updated when ``import_new_pubkeys`` admits deposits. Row
+  index == validator index, append-only (exits leave their rows
+  resident — an exited validator's historical signatures still verify).
+  Uploads are CHUNKED (``upload_chunk_rows``) so a 1M-validator table
+  never needs one giant host buffer in flight; capacity grows on a
+  coarse ladder so the gather program's compile is keyed on a handful
+  of shapes, and growth copies the old rows DEVICE-side (no re-upload).
+* **Identity pinned to the host cache** — the table resolves a packed
+  set's pubkey POINTS through an ``id(point) -> index`` map built only
+  from the cache's own immortal point objects (the cache list is
+  append-only and the table holds the cache alive, so a hit proves the
+  argument IS that exact object). A set built from any other
+  state/cache — VC tests, library callers, pre-admission gossip — can
+  never silently verify against the wrong key: it misses the map and
+  falls back to the raw limb-plane pack.
+* **Epoch-stable aggregate-pubkey sums** — committee sets whose index
+  tuple repeats (sync-committee periods, identical attestation
+  aggregates; the committee cost model arxiv 2302.00418 makes these
+  epoch-stable) collapse to a SINGLE table row holding the host-summed
+  aggregate point, so a K-wide committee set ships one index and pays
+  one K=1 gather lane. Sums are inserted on the SECOND sighting of a
+  tuple (``agg_min_repeats``) so one-shot participation subsets never
+  pay the host point-add cost, and the bounded aggregate region resets
+  wholesale when full.
+
+The verdict is IDENTICAL by construction: the gathered rows are the
+same limb encodings the raw packer ships, and an aggregate row is the
+same group element the device's masked K-axis sum produces (a sum that
+degenerates to infinity is never cached — it keeps failing through the
+device's ``agg_inf_bad`` screen like the raw path).
+
+jax-free at import (the flush planner and the metrics lint import this
+module on boxes that must not initialize a backend); every device
+operation imports jax lazily. The process-global seam
+(:func:`set_table` / :func:`get_active_table`) mirrors the compile
+service's: the client builder owns the lifecycle, ``TpuBackend`` and
+the flush planner reach the table without plumbing a handle through
+every caller.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ...utils import flight_recorder, metrics
+
+# limbs per field element; pinned == fp.NL by test (this module must not
+# import the device fp module, which pulls jax)
+NL = 32
+G1_ROW_SHAPE = (2, NL)          # affine (x, y) limb rows
+G1_ROW_BYTES = 2 * NL * 4       # int32
+
+# Validator-region capacity ladder: the gather program's compile is
+# keyed on the table array shape, so capacity moves in coarse steps —
+# log-many shapes between genesis and a 1M-validator registry.
+CAPACITY_LADDER = (1024, 4096, 16384, 65536, 262144, 1048576)
+
+_ENV_ENABLED = "LIGHTHOUSE_TPU_KEY_TABLE"
+_ENV_MAX_AGG = "LIGHTHOUSE_TPU_KEY_TABLE_MAX_AGG"
+_ENV_CHUNK = "LIGHTHOUSE_TPU_KEY_TABLE_CHUNK"
+
+DEFAULT_MAX_AGGREGATES = 4096
+DEFAULT_UPLOAD_CHUNK_ROWS = 65536
+DEFAULT_AGG_MIN_REPEATS = 2
+# the repeat-counting sketch is bounded too: when it exceeds this many
+# distinct tuples it resets wholesale (it only gates INSERTS; losing it
+# costs one extra sighting before a tuple collapses again)
+_AGG_SEEN_CAP = 65536
+
+
+def table_capacity(n: int) -> int:
+    """Validator-region capacity for ``n`` resident rows: the smallest
+    ladder rung covering it (beyond the ladder: next 1M multiple)."""
+    for c in CAPACITY_LADDER:
+        if n <= c:
+            return c
+    top = CAPACITY_LADDER[-1]
+    return ((n + top - 1) // top) * top
+
+
+def env_enabled() -> bool:
+    return os.environ.get(_ENV_ENABLED, "1") not in ("", "0")
+
+
+class KeyTableError(RuntimeError):
+    """Host-cache/device-table identity cannot be maintained (gap,
+    shrunken cache, invalid row). Raised BEFORE any device mutation —
+    sync is all-or-nothing."""
+
+
+# ---------------------------------------------------------------------------
+# Telemetry (families under the existing bls_device_ prefix; documented
+# in docs/OBSERVABILITY.md, linted by tests/test_zgate4_metrics_lint.py)
+# ---------------------------------------------------------------------------
+
+_ENTRIES = metrics.gauge_vec(
+    "bls_device_key_table_entries",
+    "rows resident in the device pubkey table, by region (validators = "
+    "index-identical mirror of ValidatorPubkeyCache, append-only; "
+    "aggregates = cached epoch-stable aggregate-pubkey sums)",
+    ("region",),
+)
+_DEVICE_BYTES = metrics.gauge(
+    "bls_device_key_table_device_bytes",
+    "device bytes held by the pubkey table array (validator capacity + "
+    "aggregate region, limb-packed G1 rows)",
+)
+_UPLOAD_BYTES = metrics.counter_vec(
+    "bls_device_key_table_upload_bytes_total",
+    "host→device bytes uploaded into the key table, by reason (startup "
+    "= initial mirror, delta = deposit admissions, aggregate = cached "
+    "committee sums). Capacity growth copies device-side and uploads "
+    "nothing",
+    ("reason",),
+)
+_SETS = metrics.counter_vec(
+    "bls_device_key_table_sets_total",
+    "signature sets by pubkey-shipping path: indexed = shipped as table "
+    "indices (device gather), collapsed = shipped as ONE cached "
+    "aggregate-sum index (K=1), raw = table attached but at least one "
+    "key not resident, so the whole batch fell back to the G1 limb "
+    "plane. hit ratio = (indexed+collapsed) / all",
+    ("path",),
+)
+_AGG_EVENTS = metrics.counter_vec(
+    "bls_device_key_table_agg_events_total",
+    "aggregate-sum cache LOOKUP events: hit (cached tuple found — warm "
+    "routing may still ship it un-collapsed; sets_total{collapsed} is "
+    "the shipping truth), miss (tuple not cached), insert (host sum "
+    "computed + row uploaded), reset (bounded region recycled "
+    "wholesale)",
+    ("event",),
+)
+
+
+# ---------------------------------------------------------------------------
+# The table
+# ---------------------------------------------------------------------------
+
+
+class DeviceKeyTable:
+    """Device mirror of a host pubkey cache (see module docstring).
+
+    ``cache`` needs only a ``pubkeys`` list of ``bls.PublicKey``-shaped
+    objects (``.point`` attribute) that is append-only for the table's
+    lifetime — the chain's ``ValidatorPubkeyCache`` and the bench's shim
+    both qualify. The table holds ``cache`` alive, which is what makes
+    the ``id(point)`` identity map sound."""
+
+    def __init__(
+        self,
+        cache,
+        max_aggregates: Optional[int] = None,
+        upload_chunk_rows: Optional[int] = None,
+        agg_min_repeats: int = DEFAULT_AGG_MIN_REPEATS,
+    ):
+        self.cache = cache
+        if max_aggregates is None:
+            try:
+                max_aggregates = int(os.environ.get(_ENV_MAX_AGG, ""))
+            except ValueError:
+                max_aggregates = DEFAULT_MAX_AGGREGATES
+        if upload_chunk_rows is None:
+            try:
+                upload_chunk_rows = int(os.environ.get(_ENV_CHUNK, ""))
+            except ValueError:
+                upload_chunk_rows = DEFAULT_UPLOAD_CHUNK_ROWS
+        self.max_aggregates = max(0, int(max_aggregates))
+        self.upload_chunk_rows = max(1, int(upload_chunk_rows))
+        self.agg_min_repeats = max(1, int(agg_min_repeats))
+        self._lock = threading.Lock()
+        # TWO device arrays: the validator mirror [cap_v, 2, NL] and the
+        # small aggregate region [max(1, max_agg), 2, NL]. Separate so an
+        # aggregate insert's functional .at.set copies ~1 MB, not the
+        # whole (potentially 256 MB) validator table, and so cached sums
+        # survive validator-capacity growth (the encoded index cap_v +
+        # slot is recomputed against the CURRENT base on every resolve).
+        self._dev = None
+        self._agg_dev = None
+        self._cap_v = 0                     # validator-region capacity
+        self._n = 0                         # validator rows resident
+        self._point_ids: Dict[int, int] = {}
+        # aggregate-sum region (slots live at index cap_v + slot).
+        # Resets are DEFERRED (_agg_reset_pending) to the start of the
+        # next resolve_sets call and guarded by a generation counter: a
+        # slot handed out earlier in a batch must stay valid until that
+        # batch's snapshot is taken — a mid-batch recycle would point an
+        # already-encoded index at a different committee's sum.
+        self._agg_slots: Dict[bytes, Optional[int]] = {}  # None = never cache
+        self._agg_seen: Dict[bytes, int] = {}
+        self._agg_next = 0
+        self._agg_resets = 0
+        self._agg_gen = 0
+        self._agg_reset_pending = False
+        # shadow counters for status() (the health endpoint should not
+        # parse the exposition to describe the table)
+        self._uploads = {"startup": 0, "delta": 0, "aggregate": 0}
+        self._sets = {"indexed": 0, "collapsed": 0, "raw": 0}
+        self._agg_hits = 0
+        self._agg_inserts = 0
+
+    # -- sync (startup + delta admission) ---------------------------------
+
+    def sync(self, reason: str = "delta") -> int:
+        """Mirror host-cache rows [resident, len(cache)) onto the device.
+        ALL-OR-NOTHING: rows are validated and packed, and the new device
+        array fully assembled, before any table state commits — a gap or
+        invalid row raises :class:`KeyTableError` and leaves the table
+        exactly as it was. Returns the number of rows added.
+
+        The expensive work — pure-Python limb packing of every new row
+        and the host→device upload — runs OUTSIDE the table lock against
+        snapshots (same discipline as ``resolve_sets``' EC sums): a
+        multi-thousand-validator catch-up delta must not stall every
+        verifier thread and the block-import listener behind host
+        packing. The commit re-checks the snapshots and retries on the
+        (rare: builder + admission listener) concurrent-sync race."""
+        for _attempt in range(16):
+            with self._lock:
+                n_start = self._n
+                cap_start = self._cap_v
+                dev_start = self._dev
+                pubkeys = list(self.cache.pubkeys)
+            n_host = len(pubkeys)
+            if n_host < n_start:
+                raise KeyTableError(
+                    f"host cache shrank to {n_host} rows below the "
+                    f"{n_start} resident device rows — the cache contract "
+                    f"is append-only"
+                )
+            if n_host == n_start:
+                return 0
+            new = pubkeys[n_start:n_host]
+            rows, points = self._pack_rows(new, base_index=n_start)
+            dev, cap_v, grew = self._grown_array(
+                dev_start, cap_start, n_start, n_host
+            )
+            dev = self._write_rows(dev, n_start, rows)
+            fresh_agg = None
+            if self._agg_dev is None:  # first sync only (benign racy read)
+                import jax.numpy as jnp
+
+                # max(1, ...): a zero-row array would make the gather's
+                # take degenerate; with max_aggregates=0 no aggregate
+                # index is ever issued, the row is just dead ballast
+                fresh_agg = jnp.zeros(
+                    (max(1, self.max_aggregates), *G1_ROW_SHAPE), jnp.int32
+                )
+            nbytes = int(rows.nbytes)
+            with self._lock:
+                if self._n != n_start or self._dev is not dev_start:
+                    continue  # a concurrent sync committed first: redo
+                # commit only now: every device write above was
+                # functional (jnp .at returns new arrays) so a raise or
+                # retry left nothing behind. Aggregate rows live in
+                # their own array and SURVIVE capacity growth — their
+                # encoded index (cap_v + slot) is recomputed against
+                # the new base on every resolve.
+                self._dev = dev
+                if self._agg_dev is None:
+                    # fresh_agg is non-None here: _agg_dev only ever
+                    # goes None -> set, so a None at commit implies the
+                    # snapshot read above also saw None and built one
+                    self._agg_dev = fresh_agg
+                self._cap_v = cap_v
+                for i, p in enumerate(points):
+                    self._point_ids[id(p)] = n_start + i
+                added = n_host - n_start
+                self._n = n_host
+                self._uploads[reason] = (
+                    self._uploads.get(reason, 0) + nbytes
+                )
+                cap_total = int(dev.shape[0]) + int(self._agg_dev.shape[0])
+            break
+        else:
+            raise KeyTableError("sync starved by concurrent syncs")
+        _ENTRIES.with_labels("validators").set(self._n)
+        _DEVICE_BYTES.set(cap_total * G1_ROW_BYTES)
+        _UPLOAD_BYTES.with_labels(reason).inc(nbytes)
+        flight_recorder.record(
+            "key_table_sync",
+            reason=reason,
+            added=added,
+            resident=self._n,
+            capacity=self._cap_v,
+            upload_bytes=nbytes,
+            grew=grew,
+        )
+        return added
+
+    def _pack_rows(self, new: Sequence, base_index: int):
+        """Validate + limb-pack host pubkeys into int32[n, 2, NL] rows.
+        Raises before any device state is touched."""
+        from . import curve
+
+        points = []
+        for off, pk in enumerate(new):
+            point = getattr(pk, "point", None)
+            if point is None or point.is_infinity():
+                raise KeyTableError(
+                    f"invalid pubkey at cache index {base_index + off}: "
+                    f"{'infinity' if point is not None else 'no point'} — "
+                    f"admission must reject it before the device mirror"
+                )
+            points.append(point)
+        rows, inf = curve.pack_g1(points)
+        if inf.any():
+            raise KeyTableError("infinity row survived packing")
+        if rows.shape[1:] != G1_ROW_SHAPE:
+            raise KeyTableError(
+                f"packed row shape {rows.shape[1:]} != {G1_ROW_SHAPE} — "
+                f"fp.NL drifted from key_table.NL"
+            )
+        return np.ascontiguousarray(rows, np.int32), points
+
+    def _grown_array(self, dev_start, cap_start: int, n_start: int,
+                     n_host: int):
+        """(device array sized for n_host, cap_v, grew): reuses the
+        snapshot array when capacity suffices, else allocates the next
+        ladder rung and copies resident validator rows DEVICE-side.
+        Pure function of its snapshots — runs outside the lock."""
+        import jax.numpy as jnp
+
+        cap_v = table_capacity(n_host)
+        if dev_start is not None and cap_v <= cap_start:
+            return dev_start, cap_start, False
+        dev = jnp.zeros((cap_v, *G1_ROW_SHAPE), jnp.int32)
+        if dev_start is not None and n_start:
+            dev = dev.at[:n_start].set(dev_start[:n_start])
+        return dev, cap_v, dev_start is not None
+
+    def _write_rows(self, dev, offset: int, rows: np.ndarray):
+        """Host→device upload of ``rows`` at ``offset``: the transfer is
+        chunked (``upload_chunk_rows`` bounds each host→device DMA) but
+        the functional table update happens ONCE — each eager ``.at.set``
+        copies the whole table array, so a per-chunk update loop would
+        pay a full-table device copy per chunk."""
+        import jax.numpy as jnp
+
+        parts = [
+            jnp.asarray(rows[i: i + self.upload_chunk_rows])
+            for i in range(0, len(rows), self.upload_chunk_rows)
+        ]
+        staged = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+        return dev.at[offset: offset + len(rows)].set(staged)
+
+    # -- resolution (the static/dynamic packer decision) ------------------
+
+    def index_of_point(self, point) -> Optional[int]:
+        """Validator index of ``point`` IF it is the host cache's own
+        object (identity, not equality — see module docstring)."""
+        return self._point_ids.get(id(point))
+
+    def resolve_sets(self, sets):
+        """Resolve prepared ``(sig, [G1Point...], msg)`` triples to table
+        indices. Returns ``None`` when ANY pubkey is not table-resident
+        (the caller falls back to the raw limb-plane pack — per
+        sub-batch, the flush planner keeps mixed flushes split), else
+        ``(per_set_index_lists, validator_array, aggregate_array,
+        n_collapsed)`` where the two device snapshots are guaranteed to
+        contain every returned index. Resolution is TWO-PHASE: every
+        set's indices resolve before any aggregate-cache mutation, so a
+        batch that falls back raw never pays host sums, row uploads or
+        aggregate telemetry for its leading sets.
+
+        Shipping-path accounting (``sets_total{indexed|collapsed}``) is
+        the DISPATCHER's job via :meth:`count_shipped` — one commit
+        point, once the batch is definitely taking the indexed path.
+        Only the ``raw`` fallback is counted here (it is final)."""
+        with self._lock:
+            if self._dev is None:
+                return None
+            if self._agg_reset_pending:
+                # deferred recycle: applied only HERE, before any slot
+                # of this batch is handed out, so every slot a batch
+                # encodes stays valid until its snapshot below
+                self._reset_aggregates_locked(journal=True)
+                self._agg_reset_pending = False
+            resolved: List[List[int]] = []
+            for _sig, pks, _msg in sets:
+                idxs = []
+                for p in pks:
+                    i = self._point_ids.get(id(p))
+                    if i is None:
+                        n = len(sets)
+                        self._sets["raw"] += n
+                        _SETS.with_labels("raw").inc(n)
+                        return None
+                    idxs.append(i)
+                resolved.append(idxs)
+            # the batch is fully resident — NOW consult the aggregate
+            # cache: hits take their slot, repeat tuples become insert
+            # candidates (sum computed OUTSIDE the lock below). Hits
+            # record the RAW slot — encoding against the validator
+            # capacity happens in the commit lock, because a concurrent
+            # capacity-growing sync() between the two phases moves the
+            # region base (slots never move; the base does)
+            hits: Dict[int, int] = {}       # set position -> RAW agg slot
+            miss_positions: Dict[bytes, List[int]] = {}
+            cand_keys: Dict[bytes, list] = {}  # key -> pks, ONE sum per key
+            if self.max_aggregates:
+                for j, (idxs, (_sig, pks, _msg)) in enumerate(
+                    zip(resolved, sets)
+                ):
+                    if len(idxs) <= 1:
+                        continue
+                    key = self._agg_key(idxs)
+                    slot = self._agg_slots.get(key, -1)
+                    if slot is None:
+                        continue  # known-uncacheable (sum is infinity)
+                    if slot >= 0:
+                        self._agg_hits += 1
+                        _AGG_EVENTS.with_labels("hit").inc()
+                        hits[j] = slot
+                        continue
+                    _AGG_EVENTS.with_labels("miss").inc()
+                    miss_positions.setdefault(key, []).append(j)
+                    if len(self._agg_seen) >= _AGG_SEEN_CAP:
+                        self._agg_seen.clear()
+                    seen = self._agg_seen.get(key, 0) + 1
+                    self._agg_seen[key] = seen
+                    if seen >= self.agg_min_repeats:
+                        # dedup by key: N repeats of one tuple in one
+                        # batch pay ONE host sum, and the slot applies
+                        # to every position below
+                        cand_keys.setdefault(key, list(pks))
+            gen = self._agg_gen
+        # host EC summation + packing WITHOUT the lock: a 512-member
+        # sync-committee sum is hundreds of pure-Python point adds, and
+        # holding the table lock for it would serialize every verifier
+        # thread and the admission listener behind host arithmetic
+        prepared: List[Tuple[bytes, Optional[np.ndarray]]] = []
+        for key, pks in cand_keys.items():
+            agg = pks[0]
+            for p in pks[1:]:
+                agg = agg + p
+            if agg.is_infinity():
+                # never cache: the raw path fails this set through the
+                # device agg_inf_bad screen, and a cached infinity row
+                # would instead trip the backend's infinity pre-screen —
+                # same verdict, different screen; keep ONE behavior
+                prepared.append((key, None))
+            else:
+                from . import curve
+
+                rows, _inf = curve.pack_g1([agg])
+                prepared.append(
+                    (key, np.ascontiguousarray(rows, np.int32))
+                )
+        collapsed = 0
+        with self._lock:
+            if self._agg_gen != gen:
+                # a reset raced this batch: every slot assigned above may
+                # have been recycled — ship K indices (correct, just not
+                # collapsed) rather than gather someone else's sum
+                hits = {}
+            else:
+                for key, row in prepared:
+                    if row is None:
+                        self._agg_slots[key] = None
+                        continue
+                    slot = self._agg_slots.get(key, -1)
+                    if slot is None:
+                        continue
+                    if slot < 0:
+                        if self._agg_next >= self.max_aggregates:
+                            # bounded region: recycle at the START of
+                            # the next batch (see ctor comment)
+                            self._agg_reset_pending = True
+                            continue
+                        slot = self._agg_next
+                        # the insert copies only the SMALL aggregate
+                        # array (~max_agg rows), never the validator
+                        # table. The seen count is KEPT: after a region
+                        # reset an evicted hot tuple re-inserts on its
+                        # very next sighting
+                        self._agg_dev = self._write_rows(
+                            self._agg_dev, slot, row
+                        )
+                        self._agg_next = slot + 1
+                        self._agg_slots[key] = slot
+                        self._agg_inserts += 1
+                        self._uploads["aggregate"] += G1_ROW_BYTES
+                        _AGG_EVENTS.with_labels("insert").inc()
+                        _UPLOAD_BYTES.with_labels("aggregate").inc(
+                            G1_ROW_BYTES
+                        )
+                        _ENTRIES.with_labels("aggregates").set(self._agg_next)
+                    # slot >= 0 here covers the raced-duplicate-insert
+                    # case too: another thread cached the same tuple
+                    # between our phases — reuse its row (for EVERY
+                    # position of this tuple in the batch)
+                    for j in miss_positions.get(key, ()):
+                        hits[j] = slot
+            # encode against the CURRENT base, inside the same lock the
+            # dev/agg snapshots are taken under: a capacity growth
+            # between the phases moved the base, and a stale encoding
+            # would gather a VALIDATOR row where the aggregate region
+            # begins
+            for j, slot in hits.items():
+                resolved[j] = [self._cap_v + slot]
+            collapsed = len(hits)
+            dev = self._dev
+            agg_dev = self._agg_dev
+        return resolved, dev, agg_dev, collapsed
+
+    def covers_sets(self, sets) -> bool:
+        """jax-free eligibility predicate for the flush planner: would
+        :meth:`resolve_sets` succeed for these sets? Accepts
+        ``SignatureSet`` objects or ``(sig, pks, msg)`` triples.
+        ``signing_indices`` (threaded by state_transition/signature_sets)
+        is a fast pre-filter; the identity map is the ground truth
+        either way, so a planner misprediction costs padding, never
+        correctness."""
+        if self._n == 0:
+            return False
+        for item in sets:
+            keys = getattr(item, "signing_keys", None)
+            if keys is None and isinstance(item, (tuple, list)) and len(item) == 3:
+                keys = item[1]
+            if not keys:
+                return False
+            idxs = getattr(item, "signing_indices", None)
+            if idxs is not None and any(
+                not 0 <= int(i) < self._n for i in idxs
+            ):
+                return False
+            for pk in keys:
+                point = getattr(pk, "point", pk)
+                if id(point) not in self._point_ids:
+                    return False
+        return True
+
+    # -- aggregate-sum cache ----------------------------------------------
+
+    @staticmethod
+    def _agg_key(idxs: Sequence[int]) -> bytes:
+        # order-insensitive: the sum is commutative, so two aggregates
+        # over the same participant set share one row
+        h = hashlib.blake2b(digest_size=16)
+        for i in sorted(idxs):
+            h.update(int(i).to_bytes(8, "little"))
+        return h.digest()
+
+    def _reset_aggregates_locked(self, journal: bool) -> None:
+        """Recycle the bounded aggregate region. ``_agg_seen`` survives
+        (it has its own cap) so an evicted hot tuple re-inserts on its
+        next sighting; the generation bump tells any batch that already
+        took slots to ship K indices instead of a recycled row."""
+        had = self._agg_next
+        self._agg_slots.clear()
+        self._agg_next = 0
+        self._agg_resets += 1
+        self._agg_gen += 1
+        _AGG_EVENTS.with_labels("reset").inc()
+        _ENTRIES.with_labels("aggregates").set(0)
+        if journal:
+            flight_recorder.record(
+                "key_table_reset", region="aggregates", dropped=had
+            )
+
+    # -- accounting helpers ------------------------------------------------
+
+    def count_shipped(self, n_indexed: int, n_collapsed: int) -> None:
+        """Commit a dispatched batch's final shipping-path accounting —
+        called by the dispatcher once the batch is definitely taking
+        the indexed path (resolution alone is not shipping)."""
+        with self._lock:
+            self._sets["indexed"] += int(n_indexed)
+            self._sets["collapsed"] += int(n_collapsed)
+        if n_indexed:
+            _SETS.with_labels("indexed").inc(int(n_indexed))
+        if n_collapsed:
+            _SETS.with_labels("collapsed").inc(int(n_collapsed))
+
+    def count_raw(self, n_sets: int) -> None:
+        """A batch fell back to the raw plane for a reason resolve_sets
+        did not see (e.g. non-Signature raw-mode screen)."""
+        with self._lock:
+            self._sets["raw"] += int(n_sets)
+        _SETS.with_labels("raw").inc(int(n_sets))
+
+    def device_arrays(self):
+        """(validator array, aggregate array) snapshot — the pair the
+        gather program dispatches against (indices >= the validator
+        array's length address the aggregate region)."""
+        with self._lock:
+            return self._dev, self._agg_dev
+
+    def __len__(self) -> int:
+        return self._n
+
+    # -- introspection -----------------------------------------------------
+
+    def status(self) -> dict:
+        """One document for the /lighthouse/health ``key_table`` block."""
+        with self._lock:
+            sets = dict(self._sets)
+            shipped = sets["indexed"] + sets["collapsed"]
+            total = shipped + sets["raw"]
+            cap_total = 0 if self._dev is None else (
+                int(self._dev.shape[0]) + int(self._agg_dev.shape[0])
+            )
+            return {
+                "validators_resident": self._n,
+                "host_cache_len": len(self.cache.pubkeys),
+                "validator_capacity": self._cap_v,
+                "aggregates_resident": self._agg_next,
+                "aggregate_capacity": self.max_aggregates,
+                "aggregate_resets": self._agg_resets,
+                "aggregate_hits": self._agg_hits,
+                "aggregate_inserts": self._agg_inserts,
+                "device_bytes": cap_total * G1_ROW_BYTES,
+                "upload_bytes": dict(self._uploads),
+                "sets": sets,
+                "hit_ratio": round(shipped / total, 4) if total else None,
+                "identity_pinned": self._n <= len(self.cache.pubkeys),
+            }
+
+
+# ---------------------------------------------------------------------------
+# Process-global table (the seam bls.TpuBackend and the flush planner
+# reach without plumbing a handle; the client builder owns the lifecycle)
+# ---------------------------------------------------------------------------
+
+_table_lock = threading.Lock()
+_table: Optional[DeviceKeyTable] = None
+
+
+def set_table(table: Optional[DeviceKeyTable]) -> None:
+    global _table
+    with _table_lock:
+        _table = table
+
+
+def clear_table(table: Optional[DeviceKeyTable] = None) -> None:
+    """Detach the global table (only if it still IS ``table`` when one
+    is given — a racing rebuild must not lose its fresh table)."""
+    global _table
+    with _table_lock:
+        if table is None or _table is table:
+            _table = None
+
+
+def get_table() -> Optional[DeviceKeyTable]:
+    return _table
+
+
+def get_active_table() -> Optional[DeviceKeyTable]:
+    """The attached table, when it has resident rows to gather from."""
+    t = _table
+    if t is not None and len(t):
+        return t
+    return None

@@ -435,6 +435,12 @@ class BeaconApiServer:
             from ..utils import transfer_ledger
 
             doc["data_movement"] = transfer_ledger.summary()
+            # device-resident pubkey table (ISSUE 10): residency,
+            # index-shipped vs raw-shipped sets (hit ratio), the
+            # aggregate-sum cache and upload accounting (null when the
+            # node runs without one)
+            ktable = getattr(chain, "device_key_table", None)
+            doc["key_table"] = None if ktable is None else ktable.status()
             return {"data": doc}
         if path == "/lighthouse/flight_recorder":
             # live journal tail: ?kind=a,b filters, ?limit=N bounds the

@@ -68,7 +68,8 @@ def block_proposal_set(
         block_root = hash_tree_root(type(block), block)
     root = compute_signing_root(None, block_root, domain)
     return bls.SignatureSet.single_pubkey(
-        _sig(signed_block.signature), _pk(resolver, block.proposer_index), root
+        _sig(signed_block.signature), _pk(resolver, block.proposer_index), root,
+        signing_index=block.proposer_index,
     )
 
 
@@ -79,7 +80,8 @@ def randao_set(
     domain = get_domain(spec, state, DOMAIN_RANDAO, epoch)
     root = compute_signing_root(ssz.Uint64, epoch, domain)
     return bls.SignatureSet.single_pubkey(
-        _sig(block.body.randao_reveal), _pk(resolver, block.proposer_index), root
+        _sig(block.body.randao_reveal), _pk(resolver, block.proposer_index), root,
+        signing_index=block.proposer_index,
     )
 
 
@@ -97,6 +99,7 @@ def proposer_slashing_sets(
                 _sig(signed_header.signature),
                 _pk(resolver, header.proposer_index),
                 root,
+                signing_index=header.proposer_index,
             )
         )
     return out
@@ -108,8 +111,11 @@ def indexed_attestation_set(
     t = types_for(preset)
     domain = get_domain(spec, state, DOMAIN_BEACON_ATTESTER, indexed.data.target.epoch)
     root = compute_signing_root(t.AttestationData, indexed.data, domain)
-    pks = [_pk(resolver, i) for i in indexed.attesting_indices]
-    return bls.SignatureSet.multiple_pubkeys(_sig(indexed.signature), pks, root)
+    indices = [int(i) for i in indexed.attesting_indices]
+    pks = [_pk(resolver, i) for i in indices]
+    return bls.SignatureSet.multiple_pubkeys(
+        _sig(indexed.signature), pks, root, signing_indices=indices
+    )
 
 
 def attestation_set(
@@ -138,7 +144,8 @@ def exit_set(
     domain = get_domain(spec, state, DOMAIN_VOLUNTARY_EXIT, exit_msg.epoch)
     root = compute_signing_root(t.VoluntaryExit, exit_msg, domain)
     return bls.SignatureSet.single_pubkey(
-        _sig(signed_exit.signature), _pk(resolver, exit_msg.validator_index), root
+        _sig(signed_exit.signature), _pk(resolver, exit_msg.validator_index), root,
+        signing_index=exit_msg.validator_index,
     )
 
 
@@ -190,13 +197,15 @@ def aggregate_and_proof_sets(
     sel_domain = get_domain(spec, state, DOMAIN_SELECTION_PROOF, epoch)
     sel_root = compute_signing_root(ssz.Uint64, att.data.slot, sel_domain)
     selection = bls.SignatureSet.single_pubkey(
-        _sig(msg.selection_proof), _pk(resolver, msg.aggregator_index), sel_root
+        _sig(msg.selection_proof), _pk(resolver, msg.aggregator_index), sel_root,
+        signing_index=msg.aggregator_index,
     )
 
     agg_domain = get_domain(spec, state, DOMAIN_AGGREGATE_AND_PROOF, epoch)
     agg_root = compute_signing_root(t.AggregateAndProof, msg, agg_domain)
     aggregator = bls.SignatureSet.single_pubkey(
-        _sig(signed_agg.signature), _pk(resolver, msg.aggregator_index), agg_root
+        _sig(signed_agg.signature), _pk(resolver, msg.aggregator_index), agg_root,
+        signing_index=msg.aggregator_index,
     )
 
     attestation = attestation_set(preset, spec, state, att, resolver)

@@ -63,6 +63,8 @@ EVENT_KINDS = (
     "compile_ready",          # compile_service/service.py, rung now warm
     "compile_started",        # compile_service/service.py, per AOT rung
     "deadline_miss",          # verification_service/batcher.py, SLO miss
+    "key_table_reset",        # crypto/device/key_table.py, agg region recycle
+    "key_table_sync",         # crypto/device/key_table.py, startup/delta rows
     "log",                    # utils/logging.py, warn/error/crit lines
     "peer_ban",               # network/peer_manager.py
     "peer_penalty",           # network/peer_manager.py

@@ -84,18 +84,30 @@ PACK_PHASES = ("decode", "limb_split", "pad", "hash", "device_put")
 OPERANDS = ("pubkeys", "signatures", "messages", "aux", "padding")
 
 
-def operand_bytes_model(b: int, k: int, m: int) -> Dict[str, int]:
-    """Exact bytes a padded (B, K, M) ``pack_signature_sets_raw`` ships
-    host→device, per operand family (the ``ndarray.nbytes`` of the
-    device_put arguments; equality pinned by test):
+# one pubkey slot on the wire: raw = a limb-packed G1 affine row + its
+# mask bool; indexed = an int32 table index + its mask bool (the device-
+# resident key table, ISSUE 10 — crypto/device/key_table.py)
+INDEXED_SLOT_BYTES = 4 + 1
 
-    * ``pubkeys``: ``pk_xy`` int32[B,K,2,NL] + ``pk_mask`` bool[B,K]
+
+def operand_bytes_model(
+    b: int, k: int, m: int, indexed: bool = False
+) -> Dict[str, int]:
+    """Exact bytes a padded (B, K, M) raw pack ships host→device, per
+    operand family (the ``ndarray.nbytes`` of the device_put arguments;
+    equality pinned by test):
+
+    * ``pubkeys``: ``pk_xy`` int32[B,K,2,NL] + ``pk_mask`` bool[B,K] —
+      or, with ``indexed=True`` (``pack_signature_sets_indexed``, the
+      static half of the packer split), ``pk_idx`` int32[B,K] +
+      ``pk_mask`` bool[B,K]
     * ``signatures``: ``sig_x`` int32[B,2,NL] + ``sig_larger`` bool[B]
     * ``messages``: ``msg_u`` int32[M,2,2,NL] + ``msg_idx`` int32[B]
     * ``aux``: ``rand`` int32[B,2] + ``set_mask`` bool[B]
     """
+    slot = INDEXED_SLOT_BYTES if indexed else G1_POINT_BYTES + 1
     out = {
-        "pubkeys": b * k * (G1_POINT_BYTES + 1),
+        "pubkeys": b * k * slot,
         "signatures": b * (_FP2_BYTES + 1),
         "messages": m * 2 * _FP2_BYTES + b * 4,
         "aux": b * (2 * 4 + 1),
@@ -105,14 +117,15 @@ def operand_bytes_model(b: int, k: int, m: int) -> Dict[str, int]:
 
 
 def live_operand_bytes(
-    n_sets: int, pk_slots: int, m_req: int
+    n_sets: int, pk_slots: int, m_req: int, indexed: bool = False
 ) -> Dict[str, int]:
     """The share of :func:`operand_bytes_model` the callers actually
     asked for: ``pk_slots`` real pubkey slots, ``n_sets`` live lanes,
     ``m_req`` distinct messages. ``padded − live`` is the padding
     share."""
+    slot = INDEXED_SLOT_BYTES if indexed else G1_POINT_BYTES + 1
     out = {
-        "pubkeys": pk_slots * (G1_POINT_BYTES + 1),
+        "pubkeys": pk_slots * slot,
         "signatures": n_sets * (_FP2_BYTES + 1),
         "messages": m_req * 2 * _FP2_BYTES + n_sets * 4,
         "aux": n_sets * (2 * 4 + 1),
@@ -416,6 +429,7 @@ def note_pack(
     total_s: float,
     operand_nbytes: Dict[str, int],
     pubkey_blobs: Sequence[bytes],
+    indexed: bool = False,
 ) -> None:
     """One raw pack completed: attribute operand bytes to the current
     (kind, path) context, feed the repeat-pubkey sketch, and stage the
@@ -425,11 +439,14 @@ def note_pack(
 
     ``operand_nbytes`` are the ACTUAL per-operand array nbytes (ground
     truth, not the model); ``pubkey_blobs`` the packed per-pubkey limb
-    rows as bytes."""
+    rows as bytes. ``indexed=True`` marks the static packer (device
+    key-table gather): the pubkey operand is the index plane, and no G1
+    blobs feed the re-upload sketch — nothing G1-shaped crossed the
+    boundary."""
     if not _enabled:
         return
     kind, path = current_context()
-    live = live_operand_bytes(n_sets, pk_slots, m_req)
+    live = live_operand_bytes(n_sets, pk_slots, m_req, indexed=indexed)
     total_bytes = 0
     by_operand = {}
     for op in ("pubkeys", "signatures", "messages", "aux"):
@@ -458,6 +475,7 @@ def note_pack(
     _tls.pending = {
         "kind": kind,
         "path": path,
+        "indexed": bool(indexed),
         "n_sets": int(n_sets),
         "b": int(b), "k": int(k), "m": int(m),
         "pk_slots": int(pk_slots), "m_req": int(m_req),
@@ -496,6 +514,7 @@ def commit_verify(verdict: Optional[bool], d2h_bytes: int = 1) -> None:
     flight_recorder.record(
         "transfer_ledger",
         kind=row["kind"], path=row["path"],
+        indexed=row.get("indexed", False),
         n_sets=row["n_sets"],
         b=row["b"], k=row["k"], m=row["m"],
         pack_s=row["pack_s"],

@@ -656,6 +656,9 @@ class VerificationScheduler:
             n_submissions=len(subs),
             n_sets=n_sets,
             n_sub_batches=len(plan.sub_batches),
+            static_sub_batches=sum(
+                1 for sb in plan.sub_batches if getattr(sb, "static", False)
+            ),
             rungs=plan.rungs_label(),
             live_lanes=plan.live,
             padded_lanes=plan.padded,

@@ -19,6 +19,14 @@ sub-batches:
   near-fixed (K, M) geometry per caller kind, so kind-homogeneous
   sub-batches stop padding the K/M axes up to the mix's max (a
   single-pubkey gossip attestation no longer pays committee-width K);
+* **split static from dynamic** (ISSUE 10) — with a device-resident
+  pubkey key table attached (``crypto/device/key_table.py``),
+  submissions whose every pubkey is table-resident ("static") are
+  packed separately from out-of-table ones ("dynamic"): the backend's
+  static/dynamic packer is all-or-nothing per batch, so without the
+  split ONE pre-admission key would degrade a whole fused flush back
+  to the G1 limb plane. A plan that separates the two wins even when
+  its lane score does not;
 * **bin-pack the B axis** — a kind group's submissions are first-fit-
   decreasing packed across ladder rungs (48 -> one 48 rung; 72 -> 64+8
   instead of 96), minimizing total padded lanes B*K*M;
@@ -141,16 +149,20 @@ def flush_geometry(sets) -> Tuple[int, int, int]:
 
 class PlannedSubBatch:
     """One dispatch of the plan: whole submissions, their live geometry,
-    and the padded rung the backend will land on."""
+    and the padded rung the backend will land on. ``static`` marks a
+    sub-batch whose every pubkey resolves to the device key table
+    (ISSUE 10): the backend ships a ``(B, K)`` index plane for it, so
+    its byte estimate uses the indexed operand model."""
 
     __slots__ = (
         "subs", "sets", "kinds", "n_sets", "k_req", "m_req",
-        "pk_slots", "rung", "cold", "live", "padded",
+        "pk_slots", "rung", "cold", "static", "live", "padded",
         "est_h2d_bytes", "est_live_h2d_bytes",
     )
 
     def __init__(self, subs: List, rung: Rung, cold: bool,
-                 n_sets: int, k_req: int, m_req: int, pk_slots: int):
+                 n_sets: int, k_req: int, m_req: int, pk_slots: int,
+                 static: bool = False):
         self.subs = subs
         self.sets = [st for s in subs for st in s.sets]
         self.kinds = "+".join(sorted({s.kind for s in subs}))
@@ -160,17 +172,19 @@ class PlannedSubBatch:
         self.pk_slots = pk_slots
         self.rung = rung
         self.cold = cold
+        self.static = static
         self.live = live_lanes(pk_slots, m_req)
         self.padded = padded_lanes(*rung)
-        # byte accounting (ISSUE 8): what the raw packer will ship
+        # byte accounting (ISSUE 8): what the packer will ship
         # host→device for this element's padded rung, and the live share
         # the callers asked for — the shared analytic model pinned
-        # against the packer's actual ndarray.nbytes by test
+        # against the packer's actual ndarray.nbytes by test. A static
+        # sub-batch prices the index plane (ISSUE 10).
         self.est_h2d_bytes = transfer_ledger.operand_bytes_model(
-            *rung
+            *rung, indexed=static
         )["total"]
         self.est_live_h2d_bytes = transfer_ledger.live_operand_bytes(
-            n_sets, pk_slots, m_req
+            n_sets, pk_slots, m_req, indexed=static
         )["total"]
 
     def waste(self) -> float:
@@ -238,6 +252,18 @@ def _largest_rung_at_most(n: int) -> int:
     return best
 
 
+def _active_key_table():
+    """The process-global device key table (ISSUE 10), reached lazily so
+    this module stays jax-free: key_table.py imports no jax at import
+    time, and the planner only calls its jax-free ``covers_sets``."""
+    try:
+        from ..crypto.device import key_table as _kt
+
+        return _kt.get_active_table()
+    except Exception:
+        return None
+
+
 class FlushPlanner:
     """Stateless-per-flush planner (see module docstring). ``overhead_
     lanes`` is the scoring charge per sub-batch beyond the first;
@@ -271,10 +297,21 @@ class FlushPlanner:
         warm (B, K, M) set for the active engine — None means no service
         attached (every exact rung dispatches; the packers pad to it)."""
         warm = None if warm_rungs is None else list(warm_rungs)
-        legacy = self._make_sub_batch(list(subs), warm)
+        table = _active_key_table()
+        subs = list(subs)
+        # classify each submission ONCE; the legacy whole-flush flag and
+        # the bin-packer's group keys both derive from this pass (no
+        # re-walk of the identity map per bin)
+        flags = [
+            bool(table is not None and self._is_static([s], table))
+            for s in subs
+        ]
+        legacy = self._make_sub_batch(
+            subs, warm, table, static=bool(subs) and all(flags)
+        )
         if not self.enabled or len(subs) == 0:
             return FlushPlan("single", [legacy], legacy.rung, legacy.cold)
-        planned = self._kind_binpacked(list(subs), warm)
+        planned = self._kind_binpacked(subs, flags, warm, table)
         if len(planned) <= 1:
             # one bin == the legacy plan re-derived; report it as single
             # (same rung by construction: one group, one bin, whole flush)
@@ -292,10 +329,22 @@ class FlushPlanner:
                 return FlushPlan("single", [legacy], legacy.rung, legacy.cold)
             if legacy.cold and not planned_cold:
                 return FlushPlan("planned", planned, legacy.rung, legacy.cold)
+        # static/dynamic separation dominates the lane score (ISSUE 10):
+        # when the split isolates key-table-resident sub-batches from
+        # raw ones and the single-rung flush would be MIXED (one raw set
+        # degrades every static set back to the G1 limb plane), the
+        # split is the point — the static share drops ~98% of its pubkey
+        # bytes, worth far more than the overhead-lane charge. An
+        # all-static or all-raw flush keeps the pure lane comparison.
+        static_split = (
+            table is not None
+            and len({sb.static for sb in planned}) > 1
+            and not legacy.static
+        )
         score = sum(sb.padded for sb in planned) + self.overhead_lanes * (
             len(planned) - 1
         )
-        if score >= legacy.padded:
+        if score >= legacy.padded and not static_split:
             return FlushPlan("single", [legacy], legacy.rung, legacy.cold)
         return FlushPlan("planned", planned, legacy.rung, legacy.cold)
 
@@ -322,8 +371,13 @@ class FlushPlanner:
         return n, k_req, m_req, pk_slots
 
     def _make_sub_batch(
-        self, subs: List, warm: Optional[List[Rung]]
+        self, subs: List, warm: Optional[List[Rung]], table=None,
+        static: Optional[bool] = None,
     ) -> PlannedSubBatch:
+        """``static=None`` classifies here (the legacy whole-flush
+        sub-batch); the bin-packer passes its group's already-known
+        flag so a flush is classified once per submission, not re-walked
+        per bin."""
         n, k_req, m_req, pk_slots = self._geometry_of(subs)
         exact: Rung = (
             round_up_bucket(max(1, n)),
@@ -338,21 +392,41 @@ class FlushPlanner:
                 rung = covering
             else:
                 cold = True
-        return PlannedSubBatch(subs, rung, cold, n, k_req, m_req, pk_slots)
+        if static is None:
+            static = bool(table is not None and self._is_static(subs, table))
+        return PlannedSubBatch(
+            subs, rung, cold, n, k_req, m_req, pk_slots, static=static
+        )
+
+    @staticmethod
+    def _is_static(subs: List, table) -> bool:
+        """Every set of every submission resolves to the device key
+        table (jax-free predicate; the backend re-verifies identity at
+        pack time, so a misprediction costs padding, never
+        correctness)."""
+        try:
+            return all(table.covers_sets(s.sets) for s in subs)
+        except Exception:
+            return False
 
     def _kind_binpacked(
-        self, subs: List, warm: Optional[List[Rung]]
+        self, subs: List, flags: List[bool], warm: Optional[List[Rung]],
+        table=None,
     ) -> List[PlannedSubBatch]:
-        """Sub-bucket by kind, then first-fit-decreasing bin-pack each
-        kind group's submissions over the B axis with bin capacity = the
-        largest ladder rung <= the group's set count (an oversized
-        submission opens its own bin — submissions never split)."""
-        groups: Dict[str, List] = {}
-        for s in subs:
-            groups.setdefault(s.kind, []).append(s)
+        """Sub-bucket by kind — and, with a device key table attached,
+        by static/dynamic eligibility (``flags``, one per submission,
+        classified once by ``plan``), so one out-of-table submission
+        cannot degrade a whole flush back to the raw limb plane — then
+        first-fit-decreasing bin-pack each group's submissions over the
+        B axis with bin capacity = the largest ladder rung <= the
+        group's set count (an oversized submission opens its own bin —
+        submissions never split)."""
+        groups: Dict[Tuple[str, bool], List] = {}
+        for s, static in zip(subs, flags):
+            groups.setdefault((s.kind, static), []).append(s)
         planned: List[PlannedSubBatch] = []
-        for kind in sorted(groups):
-            members = groups[kind]
+        for kind, _static in sorted(groups):
+            members = groups[(kind, _static)]
             n_group = sum(len(s.sets) for s in members)
             cap = _largest_rung_at_most(max(1, n_group))
             # stable FFD: big submissions first, arrival order tie-break
@@ -375,5 +449,9 @@ class FlushPlanner:
                     # a submission larger than cap still gets its own bin
                     bins.append([[sub], size])
             for members_bin, _count in bins:
-                planned.append(self._make_sub_batch(members_bin, warm))
+                planned.append(
+                    self._make_sub_batch(
+                        members_bin, warm, table, static=_static
+                    )
+                )
         return planned

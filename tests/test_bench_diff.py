@@ -17,7 +17,7 @@ import tools.bench_diff as bench_diff
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _bench_doc(sets_per_sec, waste, wrapped=False):
+def _bench_doc(sets_per_sec, waste, wrapped=False, kt_bytes=45.0):
     doc = {
         "metric": "bls_sigset_verifications_per_sec_per_chip",
         "value": sets_per_sec,
@@ -32,6 +32,12 @@ def _bench_doc(sets_per_sec, waste, wrapped=False):
             "h2d_bytes_per_set": 3000.0,
             "pack_share_of_verify_wall": 0.01,
             "pubkey_reupload_ratio": 0.8,
+            "pubkeys_bytes_per_set": 2100.0,
+        },
+        # ISSUE 10: the key-table leg's ON bytes/set is a gated metric
+        "key_table_leg": {
+            "on": {"pubkeys_bytes_per_set": kt_bytes},
+            "pubkeys_bytes_per_set_reduction": 1.0 - kt_bytes / 2100.0,
         },
     }
     return {"n": 1, "rc": 0, "parsed": doc} if wrapped else doc
@@ -74,6 +80,16 @@ def test_diff_exits_nonzero_on_regression(tmp_path):
     # within threshold: 10% slower is reported but not gated
     meh = _write(tmp_path, "d.json", _bench_doc(9.0, 0.5))
     assert bench_diff.main([old, meh]) == 0
+    # ISSUE 10 gate: the key-table leg's pubkey bytes/set regressing
+    # >20% (the table stopped shipping indices) exits nonzero too
+    kt_bad = _write(
+        tmp_path, "e_kt.json", _bench_doc(10.0, 0.5, kt_bytes=2000.0)
+    )
+    assert bench_diff.main([old, kt_bad]) == 1
+    rep_kt = bench_diff.diff(
+        bench_diff.load_bench(old), bench_diff.load_bench(kt_bad)
+    )
+    assert rep_kt["regressions"] == ["key_table_pubkeys_bytes_per_set"]
     # a gate that cannot be evaluated is reported LOUDLY, not silently
     # dropped (exit stays 0 — absence of data is not a regression)
     legacy = dict(_bench_doc(10.0, 0.5))

@@ -63,6 +63,7 @@ def _import_instrumented_modules():
     import lighthouse_tpu.beacon_processor.processor  # noqa: F401
     import lighthouse_tpu.compile_service.service  # noqa: F401
     import lighthouse_tpu.crypto.device.bls  # noqa: F401
+    import lighthouse_tpu.crypto.device.key_table  # noqa: F401
     import lighthouse_tpu.http_api.server  # noqa: F401
     import lighthouse_tpu.utils.flight_recorder  # noqa: F401
     import lighthouse_tpu.utils.logging  # noqa: F401
@@ -250,6 +251,40 @@ def test_transfer_ledger_families_registered():
     import tools.transfer_report  # noqa: F401
 
 
+def test_key_table_families_registered():
+    """ISSUE 10 families (crypto/device/key_table.py) exist under their
+    declared types + labels, and the module stays importable jax-free
+    (it registers families on boxes that must not initialize a
+    backend)."""
+    _import_instrumented_modules()
+    reg = metrics.registry_snapshot()
+    want = {
+        "bls_device_key_table_entries": ("gauge", ("region",)),
+        "bls_device_key_table_device_bytes": ("gauge", None),
+        "bls_device_key_table_upload_bytes_total": ("counter", ("reason",)),
+        "bls_device_key_table_sets_total": ("counter", ("path",)),
+        "bls_device_key_table_agg_events_total": ("counter", ("event",)),
+    }
+    for name, (kind, labels) in want.items():
+        m = reg.get(name)
+        assert m is not None, f"family {name} not registered"
+        assert m.kind == kind, (name, m.kind)
+        if labels is not None:
+            assert m.labelnames == labels, (name, m.labelnames)
+        else:
+            assert not hasattr(m, "labelnames"), name  # unlabeled family
+    # the limb layout the table mirrors must match the device fp layout
+    # WITHOUT key_table importing the (jax-pulling) fp module
+    from lighthouse_tpu.crypto.device import key_table
+
+    assert key_table.NL == 32
+    assert key_table.G1_ROW_BYTES == 2 * key_table.NL * 4
+    # the capacity ladder is sorted and strictly increasing (the gather
+    # program's compile count is bounded by its length)
+    lad = key_table.CAPACITY_LADDER
+    assert list(lad) == sorted(set(lad))
+
+
 def test_warmup_tool_imports_and_dry_run_lists_ladder(capsys, monkeypatch):
     """ISSUE 5 CI satellite: ``tools/warmup.py`` must import cleanly and
     ``--dry-run`` must list the ladder walk WITHOUT compiling anything
@@ -268,9 +303,17 @@ def test_warmup_tool_imports_and_dry_run_lists_ladder(capsys, monkeypatch):
     out = capsys.readouterr().out
     for b, k, m in DEFAULT_RUNGS:
         assert f"B={b} K={k} M={m}" in out, out
+    # ISSUE 10: the gathered rungs (key-table gather programs, one per
+    # distinct (B, K)) are listed too, so the prebake story is honest
+    # about the in-node-only warm surface
+    assert "gathered rungs" in out, out
+    for b, k in sorted({(b, k) for (b, k, _m) in DEFAULT_RUNGS}):
+        assert f"gather B={b} K={k}" in out, out
     # an explicit plan overrides the default and is echoed verbatim
     assert warmup.main(["--dry-run", "--rungs", "4:1:1"]) == 0
-    assert "B=4 K=1 M=1" in capsys.readouterr().out
+    out = capsys.readouterr().out
+    assert "B=4 K=1 M=1" in out
+    assert "gather B=4 K=1" in out
 
 
 def test_trace_schema_version_and_generators_documented():

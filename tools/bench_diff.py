@@ -53,10 +53,25 @@ METRICS = (
      ("data_movement", "pack_share_of_verify_wall"), False),
     ("data_movement_reupload_ratio",
      ("data_movement", "pubkey_reupload_ratio"), None),
+    # ISSUE 10: the device key table's acceptance metric — live pubkey
+    # bytes/set without the table (headline leg) and with it (the
+    # key_table_leg's ON measurement, gated: a regression means the
+    # table stopped shipping indices)
+    ("data_movement_pubkeys_bytes_per_set",
+     ("data_movement", "pubkeys_bytes_per_set"), False),
+    ("key_table_pubkeys_bytes_per_set",
+     ("key_table_leg", "on", "pubkeys_bytes_per_set"), False),
+    ("key_table_reduction",
+     ("key_table_leg", "pubkeys_bytes_per_set_reduction"), True),
 )
 
-# the two metrics whose regression exits nonzero (the ISSUE 8 gate)
-GATED = ("headline_sets_per_sec", "headline_padding_waste")
+# the metrics whose regression exits nonzero (ISSUE 8 throughput/waste
+# gates + the ISSUE 10 key-table bytes gate)
+GATED = (
+    "headline_sets_per_sec",
+    "headline_padding_waste",
+    "key_table_pubkeys_bytes_per_set",
+)
 
 
 def load_bench(path: str) -> dict:

@@ -244,8 +244,8 @@ def main() -> None:
       "at >0.9 over a few epochs — `tools/transfer_report.py`).")
     w("")
     w("| rung BxKxM | pubkeys B/set | signatures | messages | aux | "
-      "total B/set | pubkey share |")
-    w("|---|---|---|---|---|---|---|")
+      "total B/set | pubkey share | with key table | total w/ table |")
+    w("|---|---|---|---|---|---|---|---|---|")
     for b, k, m in (
         (64, 8, 4),      # headline bucket
         (48, 8, 4),      # exact headline rung (planner)
@@ -254,22 +254,32 @@ def main() -> None:
         (256, 16, 8),    # the large-B end the scheduler amortizes to
     ):
         ops = transfer_ledger.operand_bytes_model(b, k, m)
+        idx = transfer_ledger.operand_bytes_model(b, k, m, indexed=True)
         w(
             f"| {b}x{k}x{m} | {ops['pubkeys'] / b:,.0f} | "
             f"{ops['signatures'] / b:,.0f} | {ops['messages'] / b:,.0f} | "
             f"{ops['aux'] / b:,.0f} | {ops['total'] / b:,.0f} | "
-            f"{ops['pubkeys'] / ops['total'] * 100:.1f}% |"
+            f"{ops['pubkeys'] / ops['total'] * 100:.1f}% | "
+            f"{idx['pubkeys'] / b:,.0f} | {idx['total'] / b:,.0f} |"
         )
     w("")
     w("Pubkeys dominate at every committee width — exactly the operand "
-      "a device-resident table keyed by validator index removes from "
-      "the hot path (`submit()` would carry indices; the pack becomes "
-      "a device-side gather). Host pack time is attributed per phase "
-      "alongside (`bls_device_pack_seconds{phase}`: decode, limb_split, "
-      "pad, hash, device_put), so the pack-second share of the claim "
-      "is measured too ([OBSERVABILITY.md](OBSERVABILITY.md) "
+      "the device-resident key table (ISSUE 10, "
+      "`crypto/device/key_table.py`) removes from the hot path: "
+      "`submit()` carries validator indices and the pack becomes a "
+      "device-side gather. The `with key table` columns are the SAME "
+      "model with `indexed=True` — the static packer ships an int32 "
+      "index + mask per pubkey slot (5 B) instead of a limb-packed G1 "
+      "row (257 B); epoch-stable committee tuples collapse further to "
+      "ONE cached aggregate-sum slot (K=1). Measured counterparts: "
+      "`bls_device_key_table_sets_total{path}` (hit ratio) and the "
+      "bench `key_table_leg` (gated in `tools/bench_diff.py` on "
+      "`pubkeys_bytes_per_set`). Host pack time is attributed per "
+      "phase alongside (`bls_device_pack_seconds{phase}`: decode, "
+      "limb_split, pad, hash, device_put), so the pack-second share of "
+      "the claim is measured too ([OBSERVABILITY.md](OBSERVABILITY.md) "
       "data-movement section; per-verify rows in the `transfer_ledger` "
-      "journal events).")
+      "journal events, which now carry an `indexed` flag).")
     w("")
     w("## Reading the table")
     w("")

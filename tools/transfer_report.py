@@ -152,23 +152,75 @@ def replay_model(
     # parity with the live gauge, but a long trace must not let keys
     # age out of the window before their next epoch and undersize the
     # ROADMAP-item-2 win)
+    # ONE walk of the identity stream feeds BOTH models below: the
+    # re-upload window and the key-table residency simulation must see
+    # the exact same per-set digests or their numbers stop being
+    # comparable.
     tracker = tl.ReuploadTracker(window=window)
     slot_pos: dict = {}
     cum_re = cum_up = 0
+    # key-table hit model (ISSUE 10): a key becomes table-resident the
+    # first time it is seen (models gossip from a validator the cache
+    # admitted moments before; a table prebuilt at startup would be
+    # resident for every known validator, so this is the conservative
+    # end). A set ships indices iff ALL its keys are resident; otherwise
+    # the whole set rides the raw plane. Byte basis is LIVE per-set
+    # bytes (padding excluded on both sides) so the modeled reduction is
+    # comparable to the measured
+    # `bls_device_h2d_bytes_total{operand="pubkeys"}` per set.
+    resident: set = set()
+    sets_indexed = sets_raw = 0
+    pk_raw_bytes = pk_table_bytes = 0
+    raw_slot = tl.G1_POINT_BYTES + 1
+    idx_slot = tl.INDEXED_SLOT_BYTES
     for ev in sorted(events, key=lambda e: e["t"]):
         slot = int(ev["t"] / slot_s) if slot_s > 0 else 0
         pos = slot_pos.get((ev["kind"], slot), 0)
         slot_pos[(ev["kind"], slot)] = pos + ev["n_sets"]
-        entries = [
-            entry
-            for per_set in modeled_validator_entries(
-                ev, pos, slot_s, slots_per_epoch, tl.G1_POINT_BYTES
-            )
-            for entry in per_set
-        ]
-        re_b, up_b = tracker.observe(ev["kind"], entries)
+        per_set = modeled_validator_entries(
+            ev, pos, slot_s, slots_per_epoch, tl.G1_POINT_BYTES
+        )
+        re_b, up_b = tracker.observe(
+            ev["kind"], [entry for entries in per_set for entry in entries]
+        )
         cum_re += re_b
         cum_up += up_b
+        for entries in per_set:
+            keys = [d for d, _nb in entries]
+            hit = all(d in resident for d in keys)
+            resident.update(keys)
+            k = len(keys)
+            pk_raw_bytes += k * raw_slot
+            if hit:
+                sets_indexed += 1
+                pk_table_bytes += k * idx_slot
+            else:
+                sets_raw += 1
+                pk_table_bytes += k * raw_slot
+    n_model_sets = sets_indexed + sets_raw
+    key_table_model = {
+        "assumption": (
+            "table admitted online: a key is resident after its first "
+            "sighting; a set ships indices iff all its keys are "
+            "resident (startup-prebuilt tables only do better); "
+            "MODELED, not measured — the measured counterpart is "
+            "bls_device_key_table_sets_total and the h2d pubkeys "
+            "operand"
+        ),
+        "sets_indexed": sets_indexed,
+        "sets_raw": sets_raw,
+        "hit_ratio": (
+            round(sets_indexed / n_model_sets, 4) if n_model_sets else 0.0
+        ),
+        # live per-set pubkey-plane bytes, without vs with the table
+        "pubkey_bytes_raw_plane": pk_raw_bytes,
+        "pubkey_bytes_with_table": pk_table_bytes,
+        "pubkey_bytes_saved": pk_raw_bytes - pk_table_bytes,
+        "pubkey_reduction_ratio": (
+            round(1.0 - pk_table_bytes / pk_raw_bytes, 4)
+            if pk_raw_bytes else 0.0
+        ),
+    }
 
     reup = tracker.summary()
     pubkey_bytes = operand_totals.get("pubkeys", 0)
@@ -213,6 +265,10 @@ def replay_model(
         # the hard ceiling (every pubkey byte, were all keys resident)
         "dedup_opportunity_bytes": cum_re,
         "dedup_ceiling_bytes": pubkey_bytes,
+        # the table the repo now HAS (ISSUE 10): modeled hit ratio and
+        # pubkey-plane reduction, directly comparable to the measured
+        # win per trace
+        "key_table_model": key_table_model,
     }
 
 
@@ -306,6 +362,14 @@ def render(rep: dict) -> str:
       f"{_fmt_bytes(rep['dedup_ceiling_bytes'])} "
       f"({rep['pubkey_bytes_share'] * 100:.1f}% of all h2d bytes is "
       f"pubkeys)")
+    km = rep.get("key_table_model")
+    if km:
+        w(f"  key-table model: {km['sets_indexed']} sets index-shipped vs "
+          f"{km['sets_raw']} raw-shipped (hit ratio {km['hit_ratio']}); "
+          f"pubkey plane {_fmt_bytes(km['pubkey_bytes_raw_plane'])} -> "
+          f"{_fmt_bytes(km['pubkey_bytes_with_table'])} "
+          f"({km['pubkey_reduction_ratio'] * 100:.1f}% reduction) — "
+          f"{km['assumption']}")
     return "\n".join(lines)
 
 

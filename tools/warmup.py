@@ -88,6 +88,20 @@ def main(argv=None) -> int:
         print(f"ladder walk ({len(rungs)} rungs, priority order):")
         for i, (b, k, m) in enumerate(rungs):
             print(f"  {i + 1}. B={b} K={k} M={m}")
+        # gathered variants (ISSUE 10): with a device key table attached
+        # the service also warms the "gather" program per (B, K) —
+        # capacity-keyed, sub-second, warmed in-node (never prebaked:
+        # the gather is compiled against the LIVE table's capacity rung,
+        # which a CLI bake cannot know). Listed so the prebake story
+        # stays honest about what a warm start does NOT cover.
+        gather_rungs = sorted({(b, k) for (b, k, _m) in rungs})
+        print(
+            f"gathered rungs (device key-table gather, warmed in-node "
+            f"when a table is attached; {len(gather_rungs)} programs per "
+            f"capacity rung):"
+        )
+        for b, k in gather_rungs:
+            print(f"  gather B={b} K={k}")
         print(f"cache_dir: {cache_dir or '(none — nothing would persist)'}")
         return 0
 
