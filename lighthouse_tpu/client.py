@@ -70,6 +70,15 @@ class ClientConfig:
     # None = LIGHTHOUSE_TPU_KEY_TABLE_MAX_AGG env (default 4096); 0
     # disables the aggregate-sum region
     key_table_max_aggregates: Optional[int] = None
+    # served dp mesh width (crypto/device/mesh.py, ISSUE 11): how many
+    # devices the flush planner shards (dp x rung) plans across. None =
+    # env LIGHTHOUSE_TPU_DP_DEVICES (integer, or "all" to discover every
+    # local device; unset = 1 — per-chip health without multi-chip
+    # compile load). Virtual mesh on a single-host box: set XLA_FLAGS=
+    # --xla_force_host_platform_device_count=N before jax initializes.
+    # Only effective with bls_backend="tpu"; LIGHTHOUSE_TPU_DP_MESH=0
+    # disables the mesh entirely.
+    dp_devices: Optional[int] = None
 
 
 class Client:
@@ -127,6 +136,14 @@ class Client:
                 if listener is not None:
                     self.chain.pubkey_cache.unsubscribe(listener)
                     self.chain._key_table_listener = None
+            mesh = getattr(self.chain, "device_mesh", None)
+            if mesh is not None:
+                # last: everything above may still dispatch through the
+                # mesh while draining. Detach only OUR mesh — a racing
+                # rebuild must not lose its fresh one.
+                from .crypto.device import mesh as _mesh_mod
+
+                _mesh_mod.clear_mesh(mesh)
             self.processor.shutdown()
             self.persist()
             if self.monitoring is not None:
@@ -359,6 +376,31 @@ class ClientBuilder:
             from .ssz import hash_tree_root as _htr
 
             store.put_block(_htr(cp_block.message), cp_block)
+
+        mesh = None
+        if cfg.bls_backend == "tpu":
+            from .crypto.device import mesh as mesh_mod
+
+            if mesh_mod.env_enabled():
+                try:
+                    # mesh FIRST: the key table replicates per mesh
+                    # shard and the compile service walks the mesh
+                    # ladder — both read the seam at their own startup
+                    want = cfg.dp_devices
+                    if want is None:
+                        env_n = mesh_mod.env_devices()
+                        want = None if env_n == "all" else (env_n or 1)
+                    mesh = mesh_mod.DeviceMesh(n_devices=want)
+                    mesh_mod.set_mesh(mesh)
+                except Exception as e:
+                    from .utils import logging as tlog
+
+                    tlog.log(
+                        "warn", "device mesh unavailable",
+                        error=repr(e)[:120],
+                    )
+                    mesh = None
+        chain.device_mesh = mesh
 
         ktable = None
         if cfg.bls_backend == "tpu" and cfg.device_key_table:

@@ -116,8 +116,17 @@ def environment_key(
     return f"{platform}|jax-{jax_version}|code-{code_hash}|{fp_impl}"
 
 
-def manifest_key(env_key: str, stage: str, b: int, k: int, m: int) -> str:
-    return f"{env_key}|B{b}K{k}M{m}|{stage}"
+def manifest_key(
+    env_key: str, stage: str, b: int, k: int, m: int, device: int = 0
+) -> str:
+    """Device 0 keeps the pre-mesh key (existing manifests stay valid);
+    a mesh walk's other chips key with a ``dev{n}`` component — their
+    executables are distinct cache entries (a compile is per device
+    assignment), so their warm-start claims must be too."""
+    base = f"{env_key}|B{b}K{k}M{m}"
+    if device:
+        base += f"|dev{int(device)}"
+    return f"{base}|{stage}"
 
 
 def executable_entries(cache_dir: str) -> set | None:

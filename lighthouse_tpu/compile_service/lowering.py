@@ -164,53 +164,73 @@ def staged_instruction_counts(B: int, K: int, M: int) -> dict:
     return out
 
 
-def warm_gather(B: int, K: int, table) -> dict:
+def _shard_scope(shard):
+    """The dispatch scope a warmup runs under: ``mesh.dispatch_to`` for
+    a mesh shard (sets the thread-local shard AND jax's default device,
+    so the dummy args and the staged dispatch land on THAT chip — the
+    compile the mesh ladder is paying for), a no-op otherwise."""
+    from ..crypto.device import mesh as mesh_mod
+
+    if shard is None or mesh_mod.get_active_mesh() is None:
+        import contextlib
+
+        return contextlib.nullcontext()
+    return mesh_mod.dispatch_to(int(shard))
+
+
+def warm_gather(B: int, K: int, table, shard=None) -> dict:
     """Warm the device key-table gather program (ISSUE 10) for rung
     (B, K) against ``table``'s CURRENT device array — the gathered
     variant of the rung, keyed on the table's capacity rung
-    (key_table.CAPACITY_LADDER). Dispatched through ``bls._run_stage``
-    (stage label "gather") like the staged programs, so the recompile
-    counter and the stage histogram see exactly what gathered traffic
-    sees. Sub-second on every backend (one take + reshape); not
-    manifested — a restart re-warms it in-process."""
+    (key_table.CAPACITY_LADDER). With a mesh shard (ISSUE 11) the
+    gather warms against THAT device's replica. Dispatched through
+    ``bls._run_stage`` (stage label "gather") like the staged programs,
+    so the recompile counter and the stage histogram see exactly what
+    gathered traffic sees. Sub-second on every backend (one take +
+    reshape); not manifested — a restart re-warms it in-process."""
     import jax.numpy as jnp
 
     from ..crypto.device import bls as dbls
 
-    dev, agg = table.device_arrays()
-    if dev is None:
-        raise StageWarmupError(
-            "gather", {}, RuntimeError("key table has no device array")
-        )
-    idx = jnp.zeros((B, K), jnp.int32)
-    try:
-        _, elapsed, fresh = dbls._run_stage(
-            "gather", dbls._gather, dev, agg, idx
-        )
-    except Exception as e:
-        raise StageWarmupError("gather", {}, e)
+    with _shard_scope(shard):
+        dev, agg = table.device_arrays()
+        if dev is None:
+            raise StageWarmupError(
+                "gather", {}, RuntimeError("key table has no device array")
+            )
+        idx = jnp.zeros((B, K), jnp.int32)
+        try:
+            _, elapsed, fresh = dbls._run_stage(
+                "gather", dbls._gather, dev, agg, idx
+            )
+        except Exception as e:
+            raise StageWarmupError("gather", {}, e)
     return {"seconds": elapsed, "fresh": fresh}
 
 
-def warm_staged(B: int, K: int, M: int) -> dict:
+def warm_staged(B: int, K: int, M: int, shard=None) -> dict:
     """Warm the staged pipeline at rung (B, K, M) under the ACTIVE fp
     impl: dispatch each module-level jitted stage on zero-filled dummy
     args THROUGH ``bls._run_stage``, so the jit dispatch cache, the
     persistent compile cache (when configured), the per-stage latency
     histogram and the recompile counter all see exactly what real
     traffic at this rung will see — a warmed signature is then NOT fresh
-    for the first real batch. Returns ``{stage: {seconds, fresh}}``."""
+    for the first real batch. ``shard`` (ISSUE 11) scopes the whole
+    warmup to a mesh device: the dummy args commit there and the
+    compile is that chip's, exactly like a sharded sub-batch's
+    dispatch. Returns ``{stage: {seconds, fresh}}``."""
     from ..crypto.device import bls as dbls
 
-    args = staged_dummy_args(B, K, M)
-    jitted = staged_jitted()
     out = {}
-    for stage in STAGES:
-        try:
-            _, elapsed, fresh = dbls._run_stage(
-                stage, jitted[stage], *args[stage]
-            )
-        except Exception as e:
-            raise StageWarmupError(stage, out, e)
-        out[stage] = {"seconds": elapsed, "fresh": fresh}
+    with _shard_scope(shard):
+        args = staged_dummy_args(B, K, M)
+        jitted = staged_jitted()
+        for stage in STAGES:
+            try:
+                _, elapsed, fresh = dbls._run_stage(
+                    stage, jitted[stage], *args[stage]
+                )
+            except Exception as e:
+                raise StageWarmupError(stage, out, e)
+            out[stage] = {"seconds": elapsed, "fresh": fresh}
     return out

@@ -89,8 +89,9 @@ _IN_FLIGHT = metrics.gauge(
 )
 _WARM_RUNGS = metrics.gauge(
     "compile_service_warm_rungs",
-    "bucket rungs (B, K, M) x fp_impl whose three staged programs are "
-    "compiled and routable",
+    "bucket rungs (B, K, M) x fp_impl x mesh device whose three staged "
+    "programs are compiled and routable (single-device nodes only ever "
+    "count device 0)",
 )
 _QUEUE_DEPTH = metrics.gauge(
     "compile_service_queue_depth",
@@ -159,10 +160,14 @@ def _geometry(sets) -> Tuple[int, int, int]:
 
 
 class WarmShapeRegistry:
-    """Thread-safe set of (B, K, M, fp_impl) rungs whose staged programs
-    are compiled. ``invalidate()`` bumps an epoch so an in-flight compile
-    that started before e.g. an ``fp.set_impl`` switch +
-    ``device.reset_compiled_state()`` cannot resurrect a stale rung."""
+    """Thread-safe set of (B, K, M, fp_impl, device) rungs whose staged
+    programs are compiled — ``device`` is the dp-mesh shard index
+    (ISSUE 11; always 0 on a single-device node, and a jitted program
+    compiled for one chip is NOT routable on another: each device key
+    is its own compile). ``invalidate()`` bumps an epoch so an
+    in-flight compile that started before e.g. an ``fp.set_impl``
+    switch + ``device.reset_compiled_state()`` cannot resurrect a stale
+    rung."""
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -174,38 +179,56 @@ class WarmShapeRegistry:
         with self._lock:
             return self._epoch
 
-    def mark_ready(self, rung: Rung, impl: str, epoch: int | None = None) -> bool:
-        """Record ``rung`` warm under ``impl``; False when the mark is
-        stale (epoch advanced since the compile started) or already
-        present."""
+    def mark_ready(
+        self, rung: Rung, impl: str, epoch: int | None = None,
+        device: int = 0,
+    ) -> bool:
+        """Record ``rung`` warm under ``impl`` on mesh ``device``; False
+        when the mark is stale (epoch advanced since the compile
+        started) or already present."""
         with self._lock:
             if epoch is not None and epoch != self._epoch:
                 return False
-            key = (*rung, impl)
+            key = (*rung, impl, int(device))
             if key in self._warm:
                 return False
             self._warm.add(key)
             _WARM_RUNGS.set(len(self._warm))
             return True
 
-    def is_warm(self, rung: Rung, impl: str) -> bool:
+    def is_warm(self, rung: Rung, impl: str, device: int = 0) -> bool:
         with self._lock:
-            return (*rung, impl) in self._warm
+            return (*rung, impl, int(device)) in self._warm
 
     def best_covering(
-        self, n_sets: int, k_req: int, m_req: int, impl: str
+        self, n_sets: int, k_req: int, m_req: int, impl: str,
+        device: int = 0,
     ) -> Optional[Rung]:
-        """Cheapest warm rung that can hold the request padded up
-        (B >= n_sets, K >= k_req, M >= m_req). ONE covering policy:
-        delegates to ``planner.best_covering_rung`` (min padded lanes
-        B*K*M), so the rung the flush planner scores a sub-batch at is
-        the rung this routing actually lands it on. None when nothing
-        warm covers it."""
+        """Cheapest warm rung ON ``device`` that can hold the request
+        padded up (B >= n_sets, K >= k_req, M >= m_req). ONE covering
+        policy: delegates to ``planner.best_covering_rung`` (min padded
+        lanes B*K*M), so the rung the flush planner scores a sub-batch
+        at is the rung this routing actually lands it on. None when
+        nothing warm covers it."""
         with self._lock:
-            warm = [(b, k, m) for (b, k, m, i) in self._warm if i == impl]
+            warm = [
+                (b, k, m)
+                for (b, k, m, i, d) in self._warm
+                if i == impl and d == int(device)
+            ]
         return _planner.best_covering_rung(warm, n_sets, k_req, m_req)
 
     def warm_rungs(self) -> list:
+        """Device-0 view as (B, K, M, fp_impl) tuples — the
+        single-device surface every pre-mesh caller and test reads;
+        :meth:`warm_rungs_all` carries the device axis."""
+        with self._lock:
+            return sorted(
+                (b, k, m, i) for (b, k, m, i, d) in self._warm if d == 0
+            )
+
+    def warm_rungs_all(self) -> list:
+        """Every warm (B, K, M, fp_impl, device) key."""
         with self._lock:
             return sorted(self._warm)
 
@@ -241,9 +264,12 @@ class CompileService:
         self._fallback_backend = None
         self.registry = WarmShapeRegistry()
         self._cv = threading.Condition()
+        # work items are (rung, device): the mesh ladder (ISSUE 11) —
+        # a single-device node only ever queues device 0
         self._queue: deque = deque()
         self._queued: set = set()
-        self._in_flight: Optional[Rung] = None
+        self._in_flight = None  # (rung, device) | None
+        self._devices: Tuple[int, ...] = (0,)
         self._stopped = True
         self._thread: Optional[threading.Thread] = None
         self._compiled_total = 0
@@ -269,8 +295,15 @@ class CompileService:
                 # that holds no executables (warm_warmup_s == cold)
                 if self.cache_status["enabled"]:
                     self.manifest = _cache.Manifest(self.cache_dir)
+            # mesh ladder (ISSUE 11): with a served dp mesh attached the
+            # walk is rung x device, HEADLINE RUNGS FIRST — every chip
+            # gets the big warm rung before any chip gets the next one,
+            # so the dp axis is servable at the headline shape as early
+            # as possible. Without a mesh this is the pre-mesh walk.
+            self._devices = self._mesh_devices()
             for rung in self.plan:
-                self._enqueue_locked(rung, front=False)
+                for dev in self._devices:
+                    self._enqueue_locked((rung, dev), front=False)
             self._stopped = False
             self._thread = threading.Thread(
                 target=self._loop, name="compile-service", daemon=True
@@ -304,41 +337,73 @@ class CompileService:
             self._queue.clear()
             self._queued.clear()
             for rung in self.plan:
-                # even_in_flight: a rung compiling RIGHT NOW finishes
-                # against the old epoch (its mark_ready is stale), so it
-                # must be queued again or it would stay cold until
-                # traffic demand-pages it
-                self._enqueue_locked(rung, front=False, even_in_flight=True)
+                for dev in self._devices:
+                    # even_in_flight: a rung compiling RIGHT NOW finishes
+                    # against the old epoch (its mark_ready is stale), so
+                    # it must be queued again or it would stay cold until
+                    # traffic demand-pages it
+                    self._enqueue_locked(
+                        (rung, dev), front=False, even_in_flight=True
+                    )
             self._cv.notify_all()
+
+    @staticmethod
+    def _mesh_devices() -> Tuple[int, ...]:
+        """Shard indices the ladder walks: the attached mesh's full
+        shard axis, (0,) without one. Lazy seam read — the mesh module
+        is jax-free at import."""
+        try:
+            from ..crypto.device import mesh as _mesh
+
+            m = _mesh.get_active_mesh()
+            if m is not None:
+                return tuple(m.all_shards())
+        except Exception:
+            pass
+        return (0,)
+
+    def _device_healthy(self, dev: int) -> bool:
+        if dev == 0 and len(self._devices) == 1:
+            return True  # single-device node: no mesh to consult
+        try:
+            from ..crypto.device import mesh as _mesh
+
+            m = _mesh.get_active_mesh()
+            return m is None or m.is_healthy(dev)
+        except Exception:
+            return True
 
     # -- queueing ---------------------------------------------------------
 
     def _enqueue_locked(
-        self, rung: Rung, front: bool, even_in_flight: bool = False
+        self, item, front: bool, even_in_flight: bool = False
     ) -> None:
-        if rung in self._queued:
+        if item in self._queued:
             # already queued: a demand-paged request (front=True) still
             # PROMOTES it — live traffic's shape must compile next, not
             # wait behind the remaining plan walk
-            if front and self._queue and self._queue[0] != rung:
-                self._queue.remove(rung)
-                self._queue.appendleft(rung)
+            if front and self._queue and self._queue[0] != item:
+                self._queue.remove(item)
+                self._queue.appendleft(item)
             return
-        if rung == self._in_flight and not even_in_flight:
+        if item == self._in_flight and not even_in_flight:
             return
-        self._queued.add(rung)
+        self._queued.add(item)
         if front:
-            self._queue.appendleft(rung)
+            self._queue.appendleft(item)
         else:
-            self._queue.append(rung)
+            self._queue.append(item)
         _QUEUE_DEPTH.set(len(self._queue))
         self._cv.notify()
 
-    def request(self, b: int, k: int, m: int) -> None:
-        """Ask the background worker to compile rung (b, k, m) next —
-        demand-paged warming for traffic the configured plan missed."""
+    def request(self, b: int, k: int, m: int, device: int = 0) -> None:
+        """Ask the background worker to compile rung (b, k, m) on mesh
+        ``device`` next — demand-paged warming for traffic the
+        configured plan missed."""
         with self._cv:
-            self._enqueue_locked((int(b), int(k), int(m)), front=True)
+            self._enqueue_locked(
+                ((int(b), int(k), int(m)), int(device)), front=True
+            )
 
     # -- routing ----------------------------------------------------------
 
@@ -348,31 +413,45 @@ class CompileService:
 
         return fp.get_impl()
 
-    def route(self, n_sets: int, k_req: int = 1, m_req: int = 1) -> dict:
+    def route(
+        self, n_sets: int, k_req: int = 1, m_req: int = 1,
+        device: int = 0,
+    ) -> dict:
         """Routing decision for a flush of ``n_sets`` sets with up to
-        ``k_req`` pubkeys/set and ``m_req`` distinct messages:
-        ``{"action": warm|padded|shed, "rung": (B,K,M)|None,
-        "exact": (B,K,M), "fp_impl": impl}``. Pure registry read —
-        counting/journaling belongs to :meth:`decide_flush`."""
+        ``k_req`` pubkeys/set and ``m_req`` distinct messages on mesh
+        ``device``: ``{"action": warm|padded|shed, "rung": (B,K,M)|None,
+        "exact": (B,K,M), "fp_impl": impl, "device": device}``. Pure
+        registry read — counting/journaling belongs to
+        :meth:`decide_flush`. Warmth is PER DEVICE: a rung compiled for
+        one chip does not make another chip's dispatch warm."""
         impl = self._impl()
         exact = (
             round_up_bucket(n_sets),
             round_up_bucket(k_req),
             round_up_bucket(m_req),
         )
-        if self.registry.is_warm(exact, impl):
-            return {"action": "warm", "rung": exact, "exact": exact, "fp_impl": impl}
-        covering = self.registry.best_covering(n_sets, k_req, m_req, impl)
+        if self.registry.is_warm(exact, impl, device=device):
+            return {
+                "action": "warm", "rung": exact, "exact": exact,
+                "fp_impl": impl, "device": device,
+            }
+        covering = self.registry.best_covering(
+            n_sets, k_req, m_req, impl, device=device
+        )
         if covering is not None:
             return {
                 "action": "padded", "rung": covering, "exact": exact,
-                "fp_impl": impl,
+                "fp_impl": impl, "device": device,
             }
-        return {"action": "shed", "rung": None, "exact": exact, "fp_impl": impl}
+        return {
+            "action": "shed", "rung": None, "exact": exact,
+            "fp_impl": impl, "device": device,
+        }
 
     def decide_flush(
         self, sets, caller: str = "flush",
         geometry: Optional[Tuple[int, int, int]] = None,
+        device_index: int = 0,
     ) -> dict:
         """The scheduler-facing entry: route the flush, account cold
         buckets (``compile_service_cold_routes_total``, ``cold_route``
@@ -380,9 +459,12 @@ class CompileService:
         compilation so the NEXT flush of this shape runs on device.
         ``geometry`` is the caller's precomputed (n_sets, k_req, m_req)
         — the flush planner already derived it per plan element, so it
-        is not re-extracted from the sets here."""
+        is not re-extracted from the sets here. ``device_index`` is the
+        dp shard the sub-batch will dispatch on (ISSUE 11): a rung that
+        is warm on another chip but COLD on this one sheds to the
+        fallback instead of stalling the shard's flush on a compile."""
         n, k, m = geometry if geometry is not None else _geometry(sets)
-        decision = self.route(n, k, m)
+        decision = self.route(n, k, m, device=int(device_index))
         if decision["action"] == "padded" and get_active_service() is not self:
             # the pad-up itself happens inside the device backend, which
             # consults the process-global seam (set_service) — a service
@@ -395,6 +477,7 @@ class CompileService:
                 "rung": None,
                 "exact": decision["exact"],
                 "fp_impl": decision["fp_impl"],
+                "device": decision["device"],
             }
         if decision["action"] != "warm":
             action = decision["action"]
@@ -415,27 +498,43 @@ class CompileService:
                 warm_k=None if rung is None else rung[1],
                 warm_m=None if rung is None else rung[2],
                 fp_impl=decision["fp_impl"],
+                device=decision["device"],
             )
-            self.request(eb, ek, em)
+            self.request(eb, ek, em, device=int(device_index))
         return decision
 
-    def warm_rungs_active(self) -> list:
-        """Warm (B, K, M) rungs under the ACTIVE fp engine — the rung
-        set the flush planner bin-packs onto (a planned sub-batch must
-        land warm or the plan falls back to the single rung)."""
+    def warm_rungs_active(self, device: int = 0) -> list:
+        """Warm (B, K, M) rungs under the ACTIVE fp engine on mesh
+        ``device`` — the rung set the flush planner bin-packs onto (a
+        planned sub-batch must land warm or the plan falls back to the
+        single rung)."""
         impl = self._impl()
         return [
             (b, k, m)
-            for (b, k, m, i) in self.registry.warm_rungs()
-            if i == impl
+            for (b, k, m, i, d) in self.registry.warm_rungs_all()
+            if i == impl and d == int(device)
         ]
 
-    def pads_for(self, n_sets: int, k_req: int, m_req: int) -> Optional[Rung]:
+    def warm_rungs_by_shard(self, shards) -> dict:
+        """``{shard: [(B, K, M), ...]}`` under the active engine — the
+        planner's mesh-aware warm view (ISSUE 11): a shard whose rung
+        set is empty plans COLD there and the sub-batch sheds to the
+        fallback instead of stalling the flush."""
+        impl = self._impl()
+        out = {int(s): [] for s in shards}
+        for (b, k, m, i, d) in self.registry.warm_rungs_all():
+            if i == impl and d in out:
+                out[d].append((b, k, m))
+        return out
+
+    def pads_for(
+        self, n_sets: int, k_req: int, m_req: int, device: int = 0
+    ) -> Optional[Rung]:
         """Pad target for the device packers: the warm rung a
-        warm/padded route lands on, or None when nothing warm covers the
-        request (the packers then use their default round-up — the
-        pre-service behavior)."""
-        decision = self.route(n_sets, k_req, m_req)
+        warm/padded route lands on for this mesh device, or None when
+        nothing warm covers the request (the packers then use their
+        default round-up — the pre-service behavior)."""
+        decision = self.route(n_sets, k_req, m_req, device=int(device))
         return decision["rung"]
 
     # -- fallback ---------------------------------------------------------
@@ -499,24 +598,27 @@ class CompileService:
     # -- warmth notification ---------------------------------------------
 
     def note_rung_verified(
-        self, b: int, k: int, m: int, epoch: int | None = None
+        self, b: int, k: int, m: int, epoch: int | None = None,
+        device: int = 0,
     ) -> None:
         """Organic warmth: a staged verify at (b, k, m) just succeeded on
-        the dispatch path, so its three programs are compiled — routable
-        without the background worker ever touching the rung. ``epoch``
-        is the registry epoch the caller captured BEFORE dispatching: a
-        verify racing ``device.reset_compiled_state()`` must not
-        resurrect a rung whose jit caches were just dropped."""
+        the dispatch path — on mesh ``device`` — so its three programs
+        are compiled there: routable without the background worker ever
+        touching the rung. ``epoch`` is the registry epoch the caller
+        captured BEFORE dispatching: a verify racing
+        ``device.reset_compiled_state()`` must not resurrect a rung
+        whose jit caches were just dropped."""
         rung = (int(b), int(k), int(m))
         impl = self._impl()
-        if self.registry.mark_ready(rung, impl, epoch=epoch):
+        if self.registry.mark_ready(rung, impl, epoch=epoch, device=device):
             # persisted=False: the compile happened inside the verify,
             # with no before/after cache probe around it — organic warmth
             # is in-process routing knowledge only and never writes
             # manifest entries (the AOT walk and warmup CLI, which DO
             # probe, own the warm-start claims)
             self._record_ready(
-                rung, impl, seconds=None, source="organic", persisted=False
+                rung, impl, seconds=None, source="organic",
+                persisted=False, device=device,
             )
 
     def _cache_files(self) -> Optional[set]:
@@ -534,6 +636,7 @@ class CompileService:
         seconds: float | None,
         source: str,
         persisted: bool = True,
+        device: int = 0,
     ) -> None:
         with self._cv:  # worker thread AND organic-warmth verify threads
             self._compiled_total += 1
@@ -542,7 +645,9 @@ class CompileService:
                 env_key = _cache.environment_key(impl)
                 self.manifest.add_many(
                     [
-                        _cache.manifest_key(env_key, stage, *rung)
+                        _cache.manifest_key(
+                            env_key, stage, *rung, device=device
+                        )
                         for stage in ("stage1", "stage2", "stage3")
                     ],
                     source=source,
@@ -556,6 +661,7 @@ class CompileService:
             seconds=None if seconds is None else round(seconds, 3),
             source=source,
             persisted=persisted,
+            device=device,
         )
 
     # -- background worker ------------------------------------------------
@@ -592,28 +698,38 @@ class CompileService:
                         self._in_flight = None
                         _IN_FLIGHT.set(0)
 
-    def _compile_rung(self, rung: Rung) -> None:
+    def _compile_rung(self, item) -> None:
+        # item is ((B, K, M), device); a bare (B, K, M) means device 0
+        # (direct callers/tests that predate the mesh ladder)
+        if len(item) == 2 and isinstance(item[0], tuple):
+            rung, dev = item
+        else:
+            rung, dev = tuple(item), 0
         impl = self._impl()
-        if self.registry.is_warm(rung, impl):
+        if self.registry.is_warm(rung, impl, device=dev):
             return  # warmed organically while queued
+        if not self._device_healthy(dev):
+            return  # a lost shard's rungs are dead weight, not work
         epoch = self.registry.epoch
         b, k, m = rung
         flight_recorder.record(
-            "compile_started", b=b, k=k, m=m, fp_impl=impl, source="aot"
+            "compile_started", b=b, k=k, m=m, fp_impl=impl, source="aot",
+            device=dev,
         )
         _IN_FLIGHT.set(1)
         files_before = self._cache_files()
         t0 = time.perf_counter()
         try:
             with tracing.span(
-                "compile_service.compile", b=b, k=k, m=m, fp_impl=impl
+                "compile_service.compile", b=b, k=k, m=m, fp_impl=impl,
+                device=dev,
             ):
                 if self._compile_rung_fn is not None:
                     stages = self._compile_rung_fn(b, k, m)
                 else:
                     from . import lowering
 
-                    stages = lowering.warm_staged(b, k, m)
+                    stages = lowering.warm_staged(b, k, m, shard=dev)
         except Exception as e:  # a failed compile must not kill the worker
             with self._cv:
                 self._failed_total += 1
@@ -640,13 +756,14 @@ class CompileService:
                 _COMPILES.with_labels(stage, "error").inc()
             flight_recorder.record(
                 "compile_failed", b=b, k=k, m=m, fp_impl=impl,
-                error=repr(e)[:200],
+                error=repr(e)[:200], device=dev,
             )
             from ..utils import logging as tlog
 
             tlog.log(
                 "warn", "compile service rung failed",
-                b=b, k=k, m=m, fp_impl=impl, error=repr(e)[:120],
+                b=b, k=k, m=m, fp_impl=impl, device=dev,
+                error=repr(e)[:120],
             )
             return
         seconds = time.perf_counter() - t0
@@ -669,7 +786,9 @@ class CompileService:
                 if tbl is not None:
                     from . import lowering
 
-                    grec = lowering.warm_gather(b, k, tbl)
+                    # the replicated key table's gather is warmed per
+                    # device against THAT device's replica (ISSUE 11)
+                    grec = lowering.warm_gather(b, k, tbl, shard=dev)
                     _COMPILES.with_labels("gather", "ok").inc()
                     _COMPILE_SECONDS.with_labels("gather").observe(
                         float(grec.get("seconds", 0.0))
@@ -684,9 +803,10 @@ class CompileService:
             files_before,
             any(rec.get("fresh") for rec in (stages or {}).values()),
         )
-        if self.registry.mark_ready(rung, impl, epoch=epoch):
+        if self.registry.mark_ready(rung, impl, epoch=epoch, device=dev):
             self._record_ready(
-                rung, impl, seconds=seconds, source="aot", persisted=persisted
+                rung, impl, seconds=seconds, source="aot",
+                persisted=persisted, device=dev,
             )
 
     # -- introspection ----------------------------------------------------
@@ -700,6 +820,7 @@ class CompileService:
             compiled_total = self._compiled_total
             failed_total = self._failed_total
             cold_routes = dict(self._cold_routes)
+            devices = self._devices
         prebaked = []
         if self.manifest is not None:
             try:
@@ -708,17 +829,32 @@ class CompileService:
                 )
             except Exception:
                 prebaked = []
-        return {
+        multi = len(devices) > 1
+
+        def _item(it):
+            # single-device nodes keep the pre-mesh [B, K, M] rendering;
+            # a mesh walk appends the device so operators can see WHICH
+            # chip a queued compile is for
+            (b, k, m), dev = it
+            return [b, k, m, dev] if multi else [b, k, m]
+
+        doc = {
             "running": self.active(),
             "plan": [list(r) for r in self.plan],
             "warm_rungs": [list(r) for r in self.registry.warm_rungs()],
-            "queue": [list(r) for r in queue],
-            "in_flight": None if in_flight is None else list(in_flight),
+            "queue": [_item(it) for it in queue],
+            "in_flight": None if in_flight is None else _item(in_flight),
             "compiled_total": compiled_total,
             "failed_total": failed_total,
             "cold_routes": cold_routes,
             "cache": {**self.cache_status, "prebaked_rungs": [list(r) for r in prebaked]},
         }
+        if multi:
+            doc["mesh_devices"] = list(devices)
+            doc["warm_rungs_by_device"] = [
+                list(r) for r in self.registry.warm_rungs_all()
+            ]
+        return doc
 
 
 # ---------------------------------------------------------------------------

@@ -495,13 +495,18 @@ def _active_compile_service():
 
 def _run_stage(stage: str, fn, *args):
     """One staged dispatch: recompile accounting keyed on the argument
-    (shape, dtype, fp_impl) signature, span + labeled wall-time histogram
-    closed at the device sync boundary. Returns ``(out, elapsed_s,
-    fresh)`` so the caller can journal per-stage attribution."""
+    (shape, dtype, fp_impl, mesh shard) signature — a jitted program
+    compiled for one chip is a FRESH compile on another (ISSUE 11) —
+    span + labeled wall-time histogram closed at the device sync
+    boundary. Returns ``(out, elapsed_s, fresh)`` so the caller can
+    journal per-stage attribution."""
+    from . import mesh as _mesh_mod
+
     impl = fp.get_impl()
     key = (
         stage,
         impl,
+        _mesh_mod.current_shard() or 0,
         tuple((tuple(a.shape), str(a.dtype)) for a in args),
     )
     with tracing.span(f"bls.{stage}", fp_impl=impl):
@@ -1145,6 +1150,13 @@ class TpuBackend:
         pad_b = pad_k = pad_m = None
         svc = _active_compile_service() if raw_mode else None
         warm_epoch = None
+        # the dp shard this dispatch runs on (ISSUE 11): the scheduler's
+        # sharded sub-batch scope sets it thread-locally; 0 without a
+        # mesh. Routing, recompile accounting and the organic-warmth
+        # mark are all PER SHARD — one chip's warmth is not another's.
+        from . import mesh as _mesh_mod
+
+        shard = _mesh_mod.current_shard() or 0
         if svc is not None:
             # epoch BEFORE dispatch: if reset_compiled_state() lands while
             # we verify, the organic mark below must be rejected as stale
@@ -1155,7 +1167,7 @@ class TpuBackend:
             # collapsed request routes at least as warm as the
             # uncollapsed geometry decide_flush approved — collapse can
             # never turn a warm-approved flush into a cold stall
-            rung = svc.pads_for(len(sets), k_req, m_req)
+            rung = svc.pads_for(len(sets), k_req, m_req, device=shard)
             if rung is not None:
                 pad_b, pad_k, pad_m = rung
         if resolved is not None:
@@ -1197,14 +1209,15 @@ class TpuBackend:
             sp.set(verdict=out)
         if raw_mode and svc is not None:
             # organic warmth: whatever rung this batch landed on is
-            # compiled now (whatever the verdict) — routable without the
-            # AOT worker. OUTSIDE the timed window: the first mark per
-            # rung writes the manifest to disk.
+            # compiled now ON THIS SHARD (whatever the verdict) —
+            # routable without the AOT worker. OUTSIDE the timed window:
+            # the first mark per rung writes the manifest to disk.
             svc.note_rung_verified(
                 int(args[0].shape[0]),    # B (pk_xy)
                 int(args[0].shape[1]),    # K
                 int(args[4].shape[0]),    # M (msg_u)
                 epoch=warm_epoch,
+                device=shard,
             )
         _OUTCOMES.with_labels("ok" if out else "fail").inc()
         return out

@@ -189,14 +189,19 @@ class DeviceKeyTable:
         self.upload_chunk_rows = max(1, int(upload_chunk_rows))
         self.agg_min_repeats = max(1, int(agg_min_repeats))
         self._lock = threading.Lock()
-        # TWO device arrays: the validator mirror [cap_v, 2, NL] and the
-        # small aggregate region [max(1, max_agg), 2, NL]. Separate so an
-        # aggregate insert's functional .at.set copies ~1 MB, not the
-        # whole (potentially 256 MB) validator table, and so cached sums
+        # TWO device arrays — REPLICATED per dp-mesh shard (ISSUE 11;
+        # dict shard -> array, single key 0 without a mesh): the
+        # validator mirror [cap_v, 2, NL] and the small aggregate region
+        # [max(1, max_agg), 2, NL]. Separate so an aggregate insert's
+        # functional .at.set copies ~1 MB per replica, not the whole
+        # (potentially 256 MB) validator table, and so cached sums
         # survive validator-capacity growth (the encoded index cap_v +
         # slot is recomputed against the CURRENT base on every resolve).
-        self._dev = None
-        self._agg_dev = None
+        # Replication keeps the all-or-nothing sync contract: one delta
+        # admission commits on EVERY replica or none (the new arrays for
+        # all shards are fully assembled before any commit).
+        self._dev: Dict[int, object] = {}
+        self._agg_dev: Dict[int, object] = {}
         self._cap_v = 0                     # validator-region capacity
         self._n = 0                         # validator rows resident
         self._point_ids: Dict[int, int] = {}
@@ -219,6 +224,50 @@ class DeviceKeyTable:
         self._agg_hits = 0
         self._agg_inserts = 0
 
+    # -- mesh replication helpers (ISSUE 11) ------------------------------
+
+    @staticmethod
+    def _mesh():
+        try:
+            from . import mesh as mesh_mod
+
+            return mesh_mod.get_active_mesh()
+        except Exception:
+            return None
+
+    def _replica_shards(self) -> List[int]:
+        """The shard set this table mirrors onto: every mesh shard
+        (lost chips included — their replicas are already paid for and
+        a restored chip must find its rows), else the single default
+        shard 0. Pinned to the FIRST sync's answer so replicas never
+        silently change set mid-life."""
+        if self._dev:
+            return sorted(self._dev)
+        mesh = self._mesh()
+        if mesh is not None:
+            return mesh.all_shards()
+        return [0]
+
+    def _device_of(self, shard: int):
+        mesh = self._mesh()
+        return mesh.device_for(shard) if mesh is not None else None
+
+    def _resolve_shard_locked(self) -> Optional[int]:
+        """The replica the CURRENT dispatch thread should gather from:
+        the thread-local mesh shard when set (the scheduler's sharded
+        sub-batch scope), else the lowest replica. None when that shard
+        has no replica — the caller then falls back to the raw pack
+        (self-consistent: its planes land on the dispatch device)."""
+        try:
+            from . import mesh as mesh_mod
+
+            shard = mesh_mod.current_shard()
+        except Exception:
+            shard = None
+        if shard is None:
+            return min(self._dev) if self._dev else None
+        return shard if shard in self._dev else None
+
     # -- sync (startup + delta admission) ---------------------------------
 
     def sync(self, reason: str = "delta") -> int:
@@ -235,11 +284,12 @@ class DeviceKeyTable:
         verifier thread and the block-import listener behind host
         packing. The commit re-checks the snapshots and retries on the
         (rare: builder + admission listener) concurrent-sync race."""
+        shards = self._replica_shards()
         for _attempt in range(16):
             with self._lock:
                 n_start = self._n
                 cap_start = self._cap_v
-                dev_start = self._dev
+                dev_start = dict(self._dev)  # shard -> array snapshot
                 pubkeys = list(self.cache.pubkeys)
             n_host = len(pubkeys)
             if n_host < n_start:
@@ -252,35 +302,64 @@ class DeviceKeyTable:
                 return 0
             new = pubkeys[n_start:n_host]
             rows, points = self._pack_rows(new, base_index=n_start)
-            dev, cap_v, grew = self._grown_array(
-                dev_start, cap_start, n_start, n_host
-            )
-            dev = self._write_rows(dev, n_start, rows)
+            # build EVERY replica's new array before any commit: the
+            # all-or-nothing contract spans the mesh (ISSUE 11) — one
+            # delta admission commits on every replica or none. A raise
+            # mid-build leaves nothing behind (every write is
+            # functional).
+            cap_v = table_capacity(n_host)
+            new_dev: Dict[int, object] = {}
+            grew = False
+            for s in shards:
+                dev_s, _cap_s, grew_s = self._grown_array(
+                    dev_start.get(s), cap_start, n_start, n_host,
+                    device=self._device_of(s),
+                )
+                new_dev[s] = self._write_rows(
+                    dev_s, n_start, rows, device=self._device_of(s)
+                )
+                grew = grew or grew_s
             fresh_agg = None
-            if self._agg_dev is None:  # first sync only (benign racy read)
+            if not self._agg_dev:  # first sync only (benign racy read)
                 import jax.numpy as jnp
 
                 # max(1, ...): a zero-row array would make the gather's
                 # take degenerate; with max_aggregates=0 no aggregate
                 # index is ever issued, the row is just dead ballast
-                fresh_agg = jnp.zeros(
-                    (max(1, self.max_aggregates), *G1_ROW_SHAPE), jnp.int32
-                )
-            nbytes = int(rows.nbytes)
+                fresh_agg = {}
+                for s in shards:
+                    dev = self._device_of(s)
+                    if dev is not None:
+                        import jax
+
+                        with jax.default_device(dev):
+                            fresh_agg[s] = jnp.zeros(
+                                (max(1, self.max_aggregates),
+                                 *G1_ROW_SHAPE), jnp.int32,
+                            )
+                    else:
+                        fresh_agg[s] = jnp.zeros(
+                            (max(1, self.max_aggregates), *G1_ROW_SHAPE),
+                            jnp.int32,
+                        )
+            nbytes = int(rows.nbytes) * len(shards)
             with self._lock:
-                if self._n != n_start or self._dev is not dev_start:
+                if self._n != n_start or (
+                    shards and self._dev.get(shards[0])
+                    is not dev_start.get(shards[0])
+                ):
                     continue  # a concurrent sync committed first: redo
-                # commit only now: every device write above was
-                # functional (jnp .at returns new arrays) so a raise or
-                # retry left nothing behind. Aggregate rows live in
-                # their own array and SURVIVE capacity growth — their
-                # encoded index (cap_v + slot) is recomputed against
-                # the new base on every resolve.
-                self._dev = dev
-                if self._agg_dev is None:
+                # commit only now, replica dict replaced WHOLESALE (all
+                # shards or none). Aggregate rows live in their own
+                # arrays and SURVIVE capacity growth — their encoded
+                # index (cap_v + slot) is recomputed against the new
+                # base on every resolve.
+                self._dev = new_dev
+                if not self._agg_dev:
                     # fresh_agg is non-None here: _agg_dev only ever
-                    # goes None -> set, so a None at commit implies the
-                    # snapshot read above also saw None and built one
+                    # goes empty -> populated, so empty at commit
+                    # implies the snapshot read above also saw empty
+                    # and built one
                     self._agg_dev = fresh_agg
                 self._cap_v = cap_v
                 for i, p in enumerate(points):
@@ -290,7 +369,9 @@ class DeviceKeyTable:
                 self._uploads[reason] = (
                     self._uploads.get(reason, 0) + nbytes
                 )
-                cap_total = int(dev.shape[0]) + int(self._agg_dev.shape[0])
+                cap_total = sum(
+                    int(d.shape[0]) for d in self._dev.values()
+                ) + sum(int(a.shape[0]) for a in self._agg_dev.values())
             break
         else:
             raise KeyTableError("sync starved by concurrent syncs")
@@ -304,6 +385,7 @@ class DeviceKeyTable:
             resident=self._n,
             capacity=self._cap_v,
             upload_bytes=nbytes,
+            replicas=len(shards),
             grew=grew,
         )
         return added
@@ -333,36 +415,52 @@ class DeviceKeyTable:
             )
         return np.ascontiguousarray(rows, np.int32), points
 
+    @staticmethod
+    def _on_device(device):
+        """``jax.default_device`` scope for one replica's writes (no-op
+        when the mesh has no real device object for the shard)."""
+        if device is None:
+            import contextlib
+
+            return contextlib.nullcontext()
+        import jax
+
+        return jax.default_device(device)
+
     def _grown_array(self, dev_start, cap_start: int, n_start: int,
-                     n_host: int):
+                     n_host: int, device=None):
         """(device array sized for n_host, cap_v, grew): reuses the
         snapshot array when capacity suffices, else allocates the next
-        ladder rung and copies resident validator rows DEVICE-side.
-        Pure function of its snapshots — runs outside the lock."""
+        ladder rung ON ``device`` and copies resident validator rows
+        DEVICE-side. Pure function of its snapshots — runs outside the
+        lock."""
         import jax.numpy as jnp
 
         cap_v = table_capacity(n_host)
         if dev_start is not None and cap_v <= cap_start:
             return dev_start, cap_start, False
-        dev = jnp.zeros((cap_v, *G1_ROW_SHAPE), jnp.int32)
-        if dev_start is not None and n_start:
-            dev = dev.at[:n_start].set(dev_start[:n_start])
+        with self._on_device(device):
+            dev = jnp.zeros((cap_v, *G1_ROW_SHAPE), jnp.int32)
+            if dev_start is not None and n_start:
+                dev = dev.at[:n_start].set(dev_start[:n_start])
         return dev, cap_v, dev_start is not None
 
-    def _write_rows(self, dev, offset: int, rows: np.ndarray):
-        """Host→device upload of ``rows`` at ``offset``: the transfer is
-        chunked (``upload_chunk_rows`` bounds each host→device DMA) but
-        the functional table update happens ONCE — each eager ``.at.set``
+    def _write_rows(self, dev, offset: int, rows: np.ndarray, device=None):
+        """Host→device upload of ``rows`` at ``offset`` (onto the
+        replica's own device): the transfer is chunked
+        (``upload_chunk_rows`` bounds each host→device DMA) but the
+        functional table update happens ONCE — each eager ``.at.set``
         copies the whole table array, so a per-chunk update loop would
         pay a full-table device copy per chunk."""
         import jax.numpy as jnp
 
-        parts = [
-            jnp.asarray(rows[i: i + self.upload_chunk_rows])
-            for i in range(0, len(rows), self.upload_chunk_rows)
-        ]
-        staged = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
-        return dev.at[offset: offset + len(rows)].set(staged)
+        with self._on_device(device):
+            parts = [
+                jnp.asarray(rows[i: i + self.upload_chunk_rows])
+                for i in range(0, len(rows), self.upload_chunk_rows)
+            ]
+            staged = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+            return dev.at[offset: offset + len(rows)].set(staged)
 
     # -- resolution (the static/dynamic packer decision) ------------------
 
@@ -388,7 +486,18 @@ class DeviceKeyTable:
         point, once the batch is definitely taking the indexed path.
         Only the ``raw`` fallback is counted here (it is final)."""
         with self._lock:
-            if self._dev is None:
+            if not self._dev:
+                return None
+            # the replica the CURRENT dispatch shard gathers from
+            # (ISSUE 11), resolved FIRST: a shard with no replica falls
+            # back raw before any aggregate-cache work (its packed
+            # planes then land on its own device consistently), keeping
+            # the two-phase no-side-effects-before-fallback discipline
+            shard = self._resolve_shard_locked()
+            if shard is None:
+                n = len(sets)
+                self._sets["raw"] += n
+                _SETS.with_labels("raw").inc(n)
                 return None
             if self._agg_reset_pending:
                 # deferred recycle: applied only HERE, before any slot
@@ -490,20 +599,30 @@ class DeviceKeyTable:
                             continue
                         slot = self._agg_next
                         # the insert copies only the SMALL aggregate
-                        # array (~max_agg rows), never the validator
-                        # table. The seen count is KEPT: after a region
+                        # arrays (~max_agg rows each), never the
+                        # validator table — and writes EVERY replica
+                        # under the same lock, so the mesh's aggregate
+                        # regions can never disagree on what a slot
+                        # holds. The seen count is KEPT: after a region
                         # reset an evicted hot tuple re-inserts on its
                         # very next sighting
-                        self._agg_dev = self._write_rows(
-                            self._agg_dev, slot, row
-                        )
+                        for s in list(self._agg_dev):
+                            self._agg_dev[s] = self._write_rows(
+                                self._agg_dev[s], slot, row,
+                                device=self._device_of(s),
+                            )
                         self._agg_next = slot + 1
                         self._agg_slots[key] = slot
                         self._agg_inserts += 1
-                        self._uploads["aggregate"] += G1_ROW_BYTES
+                        # counted PER REPLICA, like sync(): the row
+                        # really crossed the boundary once per chip
+                        row_bytes = G1_ROW_BYTES * max(
+                            1, len(self._agg_dev)
+                        )
+                        self._uploads["aggregate"] += row_bytes
                         _AGG_EVENTS.with_labels("insert").inc()
                         _UPLOAD_BYTES.with_labels("aggregate").inc(
-                            G1_ROW_BYTES
+                            row_bytes
                         )
                         _ENTRIES.with_labels("aggregates").set(self._agg_next)
                     # slot >= 0 here covers the raced-duplicate-insert
@@ -520,8 +639,10 @@ class DeviceKeyTable:
             for j, slot in hits.items():
                 resolved[j] = [self._cap_v + slot]
             collapsed = len(hits)
-            dev = self._dev
-            agg_dev = self._agg_dev
+            # snapshot the phase-1 shard's replica (replica dicts are
+            # only ever replaced wholesale, so the key still exists)
+            dev = self._dev[shard]
+            agg_dev = self._agg_dev.get(shard)
         return resolved, dev, agg_dev, collapsed
 
     def covers_sets(self, sets) -> bool:
@@ -600,12 +721,25 @@ class DeviceKeyTable:
             self._sets["raw"] += int(n_sets)
         _SETS.with_labels("raw").inc(int(n_sets))
 
-    def device_arrays(self):
-        """(validator array, aggregate array) snapshot — the pair the
-        gather program dispatches against (indices >= the validator
-        array's length address the aggregate region)."""
+    def device_arrays(self, shard: Optional[int] = None):
+        """(validator array, aggregate array) snapshot for one replica
+        — the pair the gather program dispatches against (indices >=
+        the validator array's length address the aggregate region).
+        ``shard=None`` resolves the current dispatch shard (falling
+        back to the lowest replica); ``(None, None)`` when that shard
+        has no replica or the table is empty."""
         with self._lock:
-            return self._dev, self._agg_dev
+            if not self._dev:
+                return None, None
+            if shard is None:
+                s = self._resolve_shard_locked()
+                if s is None:
+                    s = min(self._dev)
+            else:
+                s = int(shard)
+                if s not in self._dev:
+                    return None, None
+            return self._dev[s], self._agg_dev.get(s)
 
     def __len__(self) -> int:
         return self._n
@@ -618,10 +752,11 @@ class DeviceKeyTable:
             sets = dict(self._sets)
             shipped = sets["indexed"] + sets["collapsed"]
             total = shipped + sets["raw"]
-            cap_total = 0 if self._dev is None else (
-                int(self._dev.shape[0]) + int(self._agg_dev.shape[0])
-            )
+            cap_total = sum(
+                int(d.shape[0]) for d in self._dev.values()
+            ) + sum(int(a.shape[0]) for a in self._agg_dev.values())
             return {
+                "replicas": sorted(self._dev),
                 "validators_resident": self._n,
                 "host_cache_len": len(self.cache.pubkeys),
                 "validator_capacity": self._cap_v,

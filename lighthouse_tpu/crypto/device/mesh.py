@@ -1,0 +1,437 @@
+"""Served data-parallel device mesh for the staged BLS verifier
+(ISSUE 11, ROADMAP item 1).
+
+``DP_SCALING.json`` certifies the dp-sharded ``verify_batch_raw_fn`` at
+B=256 on a virtual mesh and ``MULTICHIP_r05.json`` passes at
+n_devices=8 — but those are *dryruns*: the node itself was
+single-device, and one chip at bench shapes tops out orders of
+magnitude short of BASELINE.json's ≥50k sets/s target. This module is
+the serving half: a process-global :class:`DeviceMesh` that the flush
+planner, the scheduler, the compile service and the key table all
+consult to spread *independent sub-batches* across chips (data-parallel
+over signature sets — the same axis the reference spreads over rayon
+cores, ``block_signature_verifier.rs:374-382``, and the axis the
+committee batch-verification cost model says compounds with batching,
+PAPERS.md arxiv 2302.00418).
+
+Design choice — **shards are whole sub-batches, not sharded arrays**:
+the flush planner already emits kind-homogeneous, independently
+dispatchable sub-batches (ISSUE 6), so the dp axis is a *second packing
+axis* ((dp_shard × rung) plans) rather than a ``jax.sharding`` spec.
+Each shard's sub-batch packs, ships and verifies on its own device via
+a thread-local dispatch context (:func:`dispatch_to` wraps the pack +
+staged dispatch in ``jax.default_device``); no collective ever runs, so
+**losing a chip degrades to fewer shards instead of killing the node**:
+the planner just drops that shard-axis entry, and an in-flight
+sub-batch on the lost device re-resolves on a failover shard with
+verdict identity preserved (the re-resolution IS a full re-verify).
+
+Health is first-class: per-chip sets/s over a rolling window, failure
+counts, lost/healthy state and per-chip ``device_memory_bytes`` feed
+the ``bls_device_shard_*`` families and the ``/lighthouse/health``
+``mesh`` block; shard transitions journal ``shard_lost`` events.
+
+Mesh discovery order (the client builder owns the lifecycle):
+``ClientConfig.dp_devices`` > env ``LIGHTHOUSE_TPU_DP_DEVICES`` > all
+local devices of the active backend. A virtual mesh on a single-host
+box comes from ``XLA_FLAGS=--xla_force_host_platform_device_count=N``
+(set BEFORE jax initializes — the recipe tests/conftest.py and
+``__graft_entry__.dryrun_multichip`` already use).
+
+jax-free at import (the scheduler, planner and tools import this
+module on boxes that must not initialize a backend); jax is imported
+lazily, and a mesh built with injected placeholder devices
+(``DeviceMesh(devices=[None, None])``) never touches jax at all — the
+shape the jax-free scheduler/planner tests drive.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence
+
+from ...utils import flight_recorder, metrics
+
+_ENV_ENABLED = "LIGHTHOUSE_TPU_DP_MESH"
+_ENV_DEVICES = "LIGHTHOUSE_TPU_DP_DEVICES"
+
+# rolling per-chip throughput window (seconds): short enough that a
+# stalled chip's sets/s visibly decays on the health page, long enough
+# to smooth flush burstiness
+_RATE_WINDOW_S = 60.0
+
+
+def env_enabled() -> bool:
+    return os.environ.get(_ENV_ENABLED, "1") not in ("", "0")
+
+
+def env_devices():
+    """The operator's dp width knob: a positive integer, the string
+    ``all``/``auto`` (discover every local device), or None when
+    unset/malformed — the client builder then defaults to a 1-wide mesh
+    (per-chip health without multi-chip compile load; widening the axis
+    is an explicit operator decision)."""
+    raw = os.environ.get(_ENV_DEVICES, "").strip().lower()
+    if raw in ("all", "auto"):
+        return "all"
+    try:
+        n = int(raw)
+    except ValueError:
+        return None
+    return n if n > 0 else None
+
+
+# ---------------------------------------------------------------------------
+# Telemetry (documented in docs/OBSERVABILITY.md + docs/MULTICHIP.md,
+# linted by tests/test_zgate4_metrics_lint.py)
+# ---------------------------------------------------------------------------
+
+_SHARD_SETS = metrics.counter_vec(
+    "bls_device_shard_sets_total",
+    "signature sets verified per mesh shard (data-parallel device "
+    "index) — the per-chip half of the aggregate sets/s story",
+    ("shard",),
+)
+_SHARD_SECONDS = metrics.histogram_vec(
+    "bls_device_shard_verify_seconds",
+    "per-shard dispatch wall time of one sharded sub-batch verify "
+    "(pack + staged dispatch on that shard's device)",
+    ("shard",),
+)
+_SHARD_FAILURES = metrics.counter_vec(
+    "bls_device_shard_failures_total",
+    "dispatch failures per mesh shard (exceptions raised by a sharded "
+    "verify; a failure whose failover re-verify succeeds marks the "
+    "shard lost — see the shard_lost journal kind)",
+    ("shard",),
+)
+_SHARD_HEALTH = metrics.gauge_vec(
+    "bls_device_shard_health",
+    "1 = shard healthy (planner packs onto it), 0 = lost (dropped "
+    "from the shard axis; the node keeps serving on the rest)",
+    ("shard",),
+)
+_SHARD_MEMORY = metrics.gauge_vec(
+    "bls_device_shard_memory_bytes",
+    "device bytes in use per mesh shard (allocator stats where the "
+    "backend reports them, else live-buffer sum attributed by device)",
+    ("shard",),
+)
+
+
+class _ShardState:
+    __slots__ = (
+        "healthy", "failures", "sets_total", "dispatches",
+        "last_dispatch_t", "window", "lost_error",
+    )
+
+    def __init__(self):
+        self.healthy = True
+        self.failures = 0
+        self.sets_total = 0
+        self.dispatches = 0
+        self.last_dispatch_t: Optional[float] = None
+        self.window: deque = deque()  # (t, n_sets)
+        self.lost_error: Optional[str] = None
+
+
+class DeviceMesh:
+    """The served dp mesh (see module docstring). ``devices`` injects an
+    explicit device list (jax Device objects, or ``None`` placeholders
+    for jax-free tests); ``n_devices`` bounds discovery. Discovery —
+    the only jax-touching path — happens in the constructor, so a mesh
+    that exists is a mesh whose devices existed at build time."""
+
+    def __init__(
+        self,
+        n_devices: Optional[int] = None,
+        devices: Optional[Sequence] = None,
+    ):
+        if devices is None:
+            import jax
+
+            devices = list(jax.devices())
+            if not devices:
+                raise RuntimeError("no devices visible to jax")
+            if n_devices is not None:
+                if n_devices > len(devices):
+                    raise RuntimeError(
+                        f"dp_devices={n_devices} but only {len(devices)} "
+                        f"devices visible (virtual mesh: set XLA_FLAGS="
+                        f"--xla_force_host_platform_device_count=N before "
+                        f"jax initializes)"
+                    )
+                devices = devices[:n_devices]
+        self.devices = list(devices)
+        if not self.devices:
+            raise RuntimeError("DeviceMesh needs at least one device")
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()  # rate denominator floor (young mesh)
+        self._shards: Dict[int, _ShardState] = {
+            i: _ShardState() for i in range(len(self.devices))
+        }
+        for i in self._shards:
+            _SHARD_HEALTH.with_labels(str(i)).set(1)
+
+    # -- topology ---------------------------------------------------------
+
+    def __len__(self) -> int:
+        return len(self.devices)
+
+    def all_shards(self) -> List[int]:
+        return sorted(self._shards)
+
+    def healthy_shards(self) -> List[int]:
+        with self._lock:
+            return sorted(i for i, s in self._shards.items() if s.healthy)
+
+    def is_healthy(self, shard: int) -> bool:
+        with self._lock:
+            st = self._shards.get(shard)
+            return st is not None and st.healthy
+
+    def primary_shard(self) -> Optional[int]:
+        """The default dispatch target when no shard context is set:
+        the lowest healthy shard (None when every chip is lost — the
+        caller then dispatches on the process default device and/or the
+        CPU fallback; the node still answers)."""
+        healthy = self.healthy_shards()
+        return healthy[0] if healthy else None
+
+    def failover_shard(self, failed: int) -> Optional[int]:
+        """Where an in-flight sub-batch re-resolves after ``failed``
+        raised: the lowest healthy shard that is not the failed one."""
+        for i in self.healthy_shards():
+            if i != failed:
+                return i
+        return None
+
+    def device_for(self, shard: int):
+        """The device object behind a shard id (None for placeholder
+        devices — the dispatch context then skips ``default_device``)."""
+        try:
+            return self.devices[shard]
+        except (IndexError, TypeError):
+            return None
+
+    # -- dispatch accounting ----------------------------------------------
+
+    def note_dispatch(self, shard: int, n_sets: int, seconds: float) -> None:
+        now = time.monotonic()
+        with self._lock:
+            st = self._shards.get(shard)
+            if st is None:
+                return
+            st.sets_total += int(n_sets)
+            st.dispatches += 1
+            st.last_dispatch_t = now
+            st.window.append((now, int(n_sets)))
+            while st.window and now - st.window[0][0] > _RATE_WINDOW_S:
+                st.window.popleft()
+        _SHARD_SETS.with_labels(str(shard)).inc(int(n_sets))
+        _SHARD_SECONDS.with_labels(str(shard)).observe(float(seconds))
+
+    def note_failure(self, shard: int, error: BaseException,
+                     lost: bool = True) -> bool:
+        """One dispatch on ``shard`` raised. ``lost=True`` (a failover
+        re-verify of the same work succeeded, so the work was fine and
+        the chip is the problem) drops the shard from the axis; returns
+        True exactly on the healthy→lost transition (the caller's cue
+        that a ``shard_lost`` event was journaled)."""
+        transition = False
+        with self._lock:
+            st = self._shards.get(shard)
+            if st is None:
+                return False
+            st.failures += 1
+            failures = st.failures
+            if lost and st.healthy:
+                st.healthy = False
+                st.lost_error = repr(error)[:200]
+                transition = True
+        _SHARD_FAILURES.with_labels(str(shard)).inc()
+        if transition:
+            _SHARD_HEALTH.with_labels(str(shard)).set(0)
+            flight_recorder.record(
+                "shard_lost",
+                shard=shard,
+                failures=failures,
+                healthy_remaining=len(self.healthy_shards()),
+                error=repr(error)[:200],
+            )
+            from ...utils import logging as tlog
+
+            tlog.log(
+                "warn", "mesh shard lost — degrading to fewer dp shards",
+                shard=shard, error=repr(error)[:120],
+            )
+        return transition
+
+    def restore_shard(self, shard: int) -> None:
+        """Operator action (or test hook): put a repaired chip back on
+        the shard axis."""
+        with self._lock:
+            st = self._shards.get(shard)
+            if st is None:
+                return
+            st.healthy = True
+            st.lost_error = None
+        _SHARD_HEALTH.with_labels(str(shard)).set(1)
+
+    # -- introspection ----------------------------------------------------
+
+    def _rate(self, st: _ShardState, now: float) -> float:
+        """Sets/s over the ROLLING window: the denominator is the
+        window length (capped by the mesh's age while it is younger
+        than one window) — dividing by the span since the window's own
+        first sample would let one burst after an idle gap read as
+        thousands of sets/s on the health page."""
+        live = [(t, n) for (t, n) in st.window if now - t <= _RATE_WINDOW_S]
+        if not live:
+            return 0.0
+        span = min(_RATE_WINDOW_S, max(1.0, now - self._t0))
+        return sum(n for _t, n in live) / span
+
+    def memory_by_shard(self) -> Dict[int, Optional[int]]:
+        """Per-chip device bytes in use (allocator stats where the
+        platform reports them; None where it does not — null-safe, and
+        never the trigger of a backend init: placeholder devices report
+        None)."""
+        out: Dict[int, Optional[int]] = {}
+        for i, dev in enumerate(self.devices):
+            val = None
+            try:
+                stats = dev.memory_stats() if dev is not None else None
+                if stats and "bytes_in_use" in stats:
+                    val = int(stats["bytes_in_use"])
+            except Exception:
+                val = None
+            out[i] = val
+            if val is not None:
+                _SHARD_MEMORY.with_labels(str(i)).set(val)
+        return out
+
+    def status(self) -> dict:
+        """The /lighthouse/health ``mesh`` block: topology, per-chip
+        health/throughput/memory, and the aggregate sets/s the dp axis
+        is currently delivering."""
+        now = time.monotonic()
+        mem = self.memory_by_shard()
+        with self._lock:
+            chips = []
+            agg_rate = 0.0
+            for i in sorted(self._shards):
+                st = self._shards[i]
+                rate = self._rate(st, now)
+                if st.healthy:
+                    agg_rate += rate
+                dev = self.devices[i] if i < len(self.devices) else None
+                chips.append({
+                    "shard": i,
+                    "device": str(dev) if dev is not None else None,
+                    "platform": getattr(dev, "platform", None),
+                    "healthy": st.healthy,
+                    "failures": st.failures,
+                    "sets_total": st.sets_total,
+                    "dispatches": st.dispatches,
+                    "sets_per_sec": round(rate, 2),
+                    "device_memory_bytes": mem.get(i),
+                    "lost_error": st.lost_error,
+                })
+            healthy = [i for i, s in self._shards.items() if s.healthy]
+        return {
+            "n_devices": len(self.devices),
+            "healthy_shards": sorted(healthy),
+            "lost_shards": sorted(set(self._shards) - set(healthy)),
+            "aggregate_sets_per_sec": round(agg_rate, 2),
+            "rate_window_s": _RATE_WINDOW_S,
+            "chips": chips,
+        }
+
+
+# ---------------------------------------------------------------------------
+# Thread-local dispatch context (the seam the scheduler wraps around a
+# sharded sub-batch so the packers + staged dispatch land on that
+# shard's device without plumbing a handle through every call)
+# ---------------------------------------------------------------------------
+
+_tls = threading.local()
+
+
+def current_shard() -> Optional[int]:
+    """The shard this thread is dispatching for (None outside any
+    :func:`dispatch_to` scope — dispatch then targets the mesh's
+    primary shard, or the process default device without a mesh)."""
+    return getattr(_tls, "shard", None)
+
+
+class dispatch_to:
+    """Context manager scoping this thread's dispatches to ``shard``'s
+    device: sets the thread-local shard AND (when the mesh's device
+    object is real) makes it jax's default device, so ``jnp.asarray``
+    in the packers and the jitted staged dispatch both land there.
+    Placeholder devices (jax-free tests) set only the thread-local."""
+
+    def __init__(self, shard: Optional[int]):
+        self.shard = shard
+        self._prev = None
+        self._dev_cm = None
+
+    def __enter__(self):
+        self._prev = getattr(_tls, "shard", None)
+        # device context FIRST: if default_device's enter raises (stale
+        # device object, backend teardown) the thread-local must stay
+        # untouched — a leaked shard would pin every later unscoped
+        # dispatch on this long-lived thread to the wrong chip
+        if self.shard is not None:
+            mesh = get_active_mesh()
+            dev = mesh.device_for(self.shard) if mesh is not None else None
+            if dev is not None:
+                import jax
+
+                self._dev_cm = jax.default_device(dev)
+                self._dev_cm.__enter__()
+        _tls.shard = self.shard
+        return self
+
+    def __exit__(self, *exc):
+        try:
+            if self._dev_cm is not None:
+                self._dev_cm.__exit__(*exc)
+        finally:
+            self._dev_cm = None
+            _tls.shard = self._prev
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Process-global mesh (the seam the scheduler, compile service, key
+# table and TpuBackend reach; the client builder owns the lifecycle)
+# ---------------------------------------------------------------------------
+
+_mesh_lock = threading.Lock()
+_mesh: Optional[DeviceMesh] = None
+
+
+def set_mesh(mesh: Optional[DeviceMesh]) -> None:
+    global _mesh
+    with _mesh_lock:
+        _mesh = mesh
+
+
+def clear_mesh(mesh: Optional[DeviceMesh] = None) -> None:
+    """Detach the global mesh (only if it still IS ``mesh`` when one is
+    given — a racing rebuild must not lose its fresh mesh)."""
+    global _mesh
+    with _mesh_lock:
+        if mesh is None or _mesh is mesh:
+            _mesh = None
+
+
+def get_active_mesh() -> Optional[DeviceMesh]:
+    """The attached mesh; None when nothing is attached (single-device
+    behavior everywhere)."""
+    return _mesh

@@ -441,6 +441,11 @@ class BeaconApiServer:
             # node runs without one)
             ktable = getattr(chain, "device_key_table", None)
             doc["key_table"] = None if ktable is None else ktable.status()
+            # served dp mesh (ISSUE 11): per-chip sets/s, shard health,
+            # per-chip device memory and the aggregate throughput the
+            # dp axis delivers (null when the node runs single-device)
+            dmesh = getattr(chain, "device_mesh", None)
+            doc["mesh"] = None if dmesh is None else dmesh.status()
             return {"data": doc}
         if path == "/lighthouse/flight_recorder":
             # live journal tail: ?kind=a,b filters, ?limit=N bounds the

@@ -73,6 +73,8 @@ EVENT_KINDS = (
     "scheduler_flush",        # verification_service/batcher.py, per batch
     "scheduler_plan",         # verification_service/batcher.py, per flush plan
     "scheduler_shed",         # verification_service/batcher.py, backpressure
+    "shard_dispatch",         # verification_service/batcher.py, dp sub-batch
+    "shard_lost",             # crypto/device/mesh.py, chip dropped from axis
     "sync_rejected",          # beacon_chain/sync_committee_verification.py
     "transfer_ledger",        # utils/transfer_ledger.py, one per verify
 )

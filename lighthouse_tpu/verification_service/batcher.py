@@ -240,6 +240,28 @@ _VERDICT_LATENCY = metrics.histogram_vec(
     "(docs/TRAFFIC_REPLAY.md)",
     ("kind", "path"),
 )
+_DP_SHARDS = metrics.gauge(
+    "verification_scheduler_dp_shards",
+    "healthy dp mesh shards the flush planner currently packs onto "
+    "(crypto/device/mesh.py; 0 = no mesh attached — single-device "
+    "dispatch). Losing a chip decrements this and the node keeps "
+    "serving on the rest",
+)
+_DP_SUBBATCHES = metrics.counter_vec(
+    "verification_scheduler_dp_subbatches_total",
+    "sharded sub-batches dispatched per dp shard (the shard axis of a "
+    "(dp x rung) flush plan; unsharded single-device dispatches are "
+    "not counted here — see verification_scheduler_plan_subbatches_"
+    "total for the rung axis)",
+    ("shard",),
+)
+_DP_SETS = metrics.counter_vec(
+    "verification_scheduler_dp_sets_total",
+    "signature sets dispatched per dp shard by the flush planner — "
+    "with bls_device_shard_sets_total this splits the aggregate "
+    "sets/s story into scheduler-side and device-side halves",
+    ("shard",),
+)
 _DEADLINE_MISSES = metrics.counter_vec(
     "verification_scheduler_deadline_misses_total",
     "submissions whose verdict landed after the SLO budget (slo_grace x "
@@ -250,6 +272,23 @@ _DEADLINE_MISSES = metrics.counter_vec(
     "what makes it an SLO",
     ("kind",),
 )
+
+
+def _mesh_module():
+    """The device-mesh module (ISSUE 11), reached lazily so this module
+    stays jax-free at import: mesh.py itself imports jax only inside
+    dispatch, and a jax-free test mesh (placeholder devices) never
+    touches it at all."""
+    from ..crypto.device import mesh as mesh_mod
+
+    return mesh_mod
+
+
+def _active_mesh():
+    try:
+        return _mesh_module().get_active_mesh()
+    except Exception:
+        return None
 
 
 class _Submission:
@@ -449,13 +488,23 @@ class VerificationScheduler:
         path = "bypass"
         try:
             with tracing.span("scheduler.bypass", kind=kind, n_sets=len(sets)):
+                # the bypass dispatches on the mesh's primary HEALTHY
+                # shard (after a chip loss the block path keeps serving
+                # on the survivors) — resolved FIRST so the cold-bucket
+                # warm check below consults the chip that will actually
+                # dispatch, not device 0's registry
+                mesh = _active_mesh()
+                primary = (
+                    mesh.primary_shard() if mesh is not None else None
+                )
                 svc = self._compile_service
                 if svc is not None and svc.active():
                     # even the latency-critical bypass must not stall on a
                     # cold-bucket XLA compile: shed to the service's counted
                     # synchronous fallback (identical verdict)
                     decision = svc.decide_flush(
-                        sets, caller=f"verify_now:{kind}"
+                        sets, caller=f"verify_now:{kind}",
+                        device_index=primary or 0,
                     )
                     if decision["action"] == "shed":
                         # SLO path follows the RESOLUTION, not the entry:
@@ -468,6 +517,15 @@ class VerificationScheduler:
                         with transfer_ledger.context(kind, path):
                             return svc.fallback_verify(sets)
                 with transfer_ledger.context(kind, path):
+                    if mesh is not None and primary is not None:
+                        t_mesh = time.monotonic()
+                        with _mesh_module().dispatch_to(primary):
+                            out = self._verify(sets)
+                        mesh.note_dispatch(
+                            primary, len(sets),
+                            time.monotonic() - t_mesh,
+                        )
+                        return out
                     return self._verify(sets)
         finally:
             # the bypass IS this caller's end-to-end latency: no queue,
@@ -550,14 +608,27 @@ class VerificationScheduler:
         # the plan: one legacy-style sub-batch, or kind-homogeneous
         # bin-packed sub-batches when that wins on padded lanes
         # (planner.py). With a compile service attached the planner only
-        # splits onto rungs the warm registry can serve.
+        # splits onto rungs the warm registry can serve; with a served
+        # device mesh attached (ISSUE 11) plans gain the dp shard axis
+        # and the warm set is PER SHARD — a cold shard sheds to the
+        # fallback instead of stalling the whole flush.
+        mesh = _active_mesh()
+        shards = mesh.healthy_shards() if mesh is not None else None
+        _DP_SHARDS.set(len(shards) if shards else 0)
         warm = None
         if svc is not None:
             try:
-                warm = svc.warm_rungs_active()
+                if shards:
+                    # per-shard view even at width 1: after a chip loss
+                    # the surviving shard may not be device 0, and its
+                    # OWN warmth — not the dead chip's — must drive the
+                    # plan
+                    warm = svc.warm_rungs_by_shard(shards)
+                else:
+                    warm = svc.warm_rungs_active()
             except Exception:
                 warm = None
-        plan = self._planner.plan(subs, warm_rungs=warm)
+        plan = self._planner.plan(subs, warm_rungs=warm, shards=shards)
         _PLANS.with_labels(plan.mode).inc()
         _FLUSHES.with_labels(trigger).inc()
         waste = plan.waste()
@@ -569,6 +640,7 @@ class VerificationScheduler:
             "mode": plan.mode,
             "n_sub_batches": len(plan.sub_batches),
             "rungs": plan.rungs_label(),
+            "dp_shards": plan.shards_used(),
             "padding_waste": round(waste, 4),
             "est_h2d_bytes": plan.est_h2d_bytes,
             "est_live_h2d_bytes": plan.est_live_h2d_bytes,
@@ -576,6 +648,13 @@ class VerificationScheduler:
         bisections_before = self._bisections
         all_ok = True
         dev_live = dev_padded = 0  # lanes of DEVICE-dispatched sub-batches
+        results: List[Optional[dict]] = [None] * len(plan.sub_batches)
+        # the dp axis is the parallelism: sub-batches on DIFFERENT
+        # shards dispatch concurrently (one worker per sub-batch —
+        # thread count is bounded by the plan, itself bounded by the
+        # mesh width x kind split), and the flush thread joins them. A
+        # single-shard (or unsharded) plan keeps the serial dispatch.
+        multi_shard = len({sb.shard for sb in plan.sub_batches}) > 1
         with tracing.span(
             "scheduler.flush",
             trigger=trigger,
@@ -584,63 +663,51 @@ class VerificationScheduler:
             n_sets=n_sets,
             mode=plan.mode,
             n_sub_batches=len(plan.sub_batches),
+            dp_shards=len(plan.shards_used()),
         ) as sp:
-            for sb in plan.sub_batches:
-                # cold-bucket routing PER PLAN ELEMENT: a sub-batch whose
-                # padded rung has no compiled staged program is served
-                # through the compile service's counted synchronous
-                # fallback (and bisects there too — verdict identity
-                # holds leaf by leaf) while the rung compiles behind it
-                verify = self._verify
-                route_action = "direct"
-                paid = sb.padded
-                if svc is not None:
-                    decision = svc.decide_flush(
-                        sb.sets,
-                        caller=f"flush:{trigger}",
-                        geometry=(sb.n_sets, sb.k_req, sb.m_req),
+            def run_one(idx: int, sb) -> None:
+                try:
+                    results[idx] = self._dispatch_sub_batch(
+                        sb, svc, mesh, plan.mode, trigger
                     )
-                    route_action = decision["action"]
-                    if route_action == "shed":
-                        verify = svc.fallback_verify
-                    elif decision["rung"] is not None:
-                        # the registry may have warmed between planning
-                        # and routing: charge the rung the device will
-                        # ACTUALLY pad to, not the one the plan assumed
-                        rb, rk, rm = decision["rung"]
-                        paid = rb * rk * rm
-                _FUSED_BATCHES.with_labels(sb.kinds).inc()
-                _PLAN_SUBBATCHES.with_labels(sb.kinds).inc()
-                if route_action != "shed":
-                    # a shed sub-batch runs on the CPU fallback: the
-                    # device paid no lanes for it
-                    _PLAN_LANES.with_labels("live").inc(sb.live)
-                    _PLAN_LANES.with_labels("padded").inc(paid)
-                    dev_live += sb.live
-                    dev_padded += paid
+                except BaseException as e:  # noqa: BLE001 — futures first
+                    # a worker must NEVER strand its futures: whatever
+                    # slipped past the dispatch path's own handling is
+                    # delivered to every submission (the caller sees the
+                    # raise a direct call would have surfaced)
+                    for s in sb.subs:
+                        self._account(s, "sub_batch")
+                        _SUBMISSIONS.with_labels(s.kind, "error").inc()
+                        if not s.future.done():
+                            s.future.set_exception(e)
+
+            if multi_shard:
+                workers = [
+                    threading.Thread(
+                        target=run_one, args=(i, sb),
+                        name=f"flush-shard-{sb.shard}", daemon=True,
+                    )
+                    for i, sb in enumerate(plan.sub_batches)
+                ]
+                for w in workers:
+                    w.start()
+                for w in workers:
+                    w.join()
+            else:
+                for i, sb in enumerate(plan.sub_batches):
+                    run_one(i, sb)
+            # bookkeeping on the flush thread (the per-sb workers only
+            # verify; self._* counters stay single-writer)
+            for sb, rec in zip(plan.sub_batches, results):
+                if rec is None:
+                    all_ok = False
+                    continue
                 self._fused_batches += 1
                 self._buckets_seen.add(sb.rung[0])
-                # SLO path label: the compile-service CPU fallback is its
-                # own resolution path (its latency profile is nothing
-                # like a device dispatch); otherwise a planned split
-                # resolves via sub_batch, a single-rung flush via fused
-                if route_action == "shed":
-                    path = "fallback"
-                elif plan.mode == "planned":
-                    path = "sub_batch"
-                else:
-                    path = "fused"
-                with tracing.span(
-                    "scheduler.sub_batch",
-                    kinds=sb.kinds,
-                    n_sets=sb.n_sets,
-                    rung="x".join(str(v) for v in sb.rung),
-                    route=route_action,
-                ):
-                    ok = self._resolve_group(
-                        sb.subs, verify, fused=sb.sets, path=path
-                    )
-                all_ok = all_ok and ok
+                if rec["route"] != "shed":
+                    dev_live += sb.live
+                    dev_padded += rec["paid"]
+                all_ok = all_ok and rec["ok"]
             sp.set(verdict=all_ok)
         if dev_padded:
             # gauges describe device lanes only (consistent with
@@ -659,6 +726,7 @@ class VerificationScheduler:
             static_sub_batches=sum(
                 1 for sb in plan.sub_batches if getattr(sb, "static", False)
             ),
+            dp_shards=plan.shards_used(),
             rungs=plan.rungs_label(),
             live_lanes=plan.live,
             padded_lanes=plan.padded,
@@ -684,6 +752,154 @@ class VerificationScheduler:
             verdict=all_ok,
             bisections=self._bisections - bisections_before,
         )
+
+    # -- sub-batch dispatch (the dp x rung plan element) ------------------
+
+    def _dispatch_sub_batch(
+        self, sb, svc, mesh, plan_mode: str, trigger: str
+    ) -> dict:
+        """Execute ONE plan element: route it (cold-bucket protection per
+        element — a sub-batch whose padded rung has no compiled staged
+        program on ITS shard is served through the compile service's
+        counted synchronous fallback, and bisects there too), dispatch
+        it on its dp shard when the plan is sharded, and resolve its
+        submissions. Runs on the flush thread for serial plans and on a
+        per-sub-batch worker for multi-shard plans — everything here is
+        thread-safe (labeled metric families lock; ``self._*`` counters
+        stay with the flush thread)."""
+        verify = self._verify
+        route_action = "direct"
+        paid = sb.padded
+        if svc is not None:
+            try:
+                decision = svc.decide_flush(
+                    sb.sets,
+                    caller=f"flush:{trigger}",
+                    geometry=(sb.n_sets, sb.k_req, sb.m_req),
+                    device_index=sb.shard or 0,
+                )
+                route_action = decision["action"]
+                if route_action == "shed":
+                    verify = svc.fallback_verify
+                elif decision["rung"] is not None:
+                    # the registry may have warmed between planning and
+                    # routing: charge the rung the device will ACTUALLY
+                    # pad to, not the one the plan assumed
+                    rb, rk, rm = decision["rung"]
+                    paid = rb * rk * rm
+            except Exception:
+                # a routing failure must never fail a flush: dispatch
+                # direct (the pre-service behavior)
+                verify = self._verify
+                route_action = "direct"
+        _FUSED_BATCHES.with_labels(sb.kinds).inc()
+        _PLAN_SUBBATCHES.with_labels(sb.kinds).inc()
+        if route_action != "shed":
+            # a shed sub-batch runs on the CPU fallback: the device paid
+            # no lanes for it
+            _PLAN_LANES.with_labels("live").inc(sb.live)
+            _PLAN_LANES.with_labels("padded").inc(paid)
+        # SLO path label: the compile-service CPU fallback is its own
+        # resolution path (its latency profile is nothing like a device
+        # dispatch); otherwise a planned split resolves via sub_batch, a
+        # single-rung flush via fused
+        if route_action == "shed":
+            path = "fallback"
+        elif plan_mode == "planned":
+            path = "sub_batch"
+        else:
+            path = "fused"
+        sharded = mesh is not None and sb.shard is not None
+        if sharded and route_action != "shed":
+            # the failover wrapper scopes every call of this sub-batch's
+            # resolution tree (bisection retries included) to its shard
+            verify = self._sharded_verify(verify, sb.shard, mesh)
+            _DP_SUBBATCHES.with_labels(str(sb.shard)).inc()
+            _DP_SETS.with_labels(str(sb.shard)).inc(sb.n_sets)
+        t0 = time.monotonic()
+        with tracing.span(
+            "scheduler.sub_batch",
+            kinds=sb.kinds,
+            n_sets=sb.n_sets,
+            rung="x".join(str(v) for v in sb.rung),
+            route=route_action,
+            shard=sb.shard,
+        ):
+            ok = self._resolve_group(
+                sb.subs, verify, fused=sb.sets, path=path
+            )
+        if sharded:
+            flight_recorder.record(
+                "shard_dispatch",
+                shard=sb.shard,
+                kinds=sb.kinds,
+                n_sets=sb.n_sets,
+                rung="x".join(str(v) for v in sb.rung),
+                route=route_action,
+                ok=ok,
+                seconds=round(time.monotonic() - t0, 6),
+            )
+        return {"ok": ok, "route": route_action, "paid": paid}
+
+    def _sharded_verify(self, verify, shard: int, mesh):
+        """Wrap ``verify`` so the whole resolution tree of one sharded
+        sub-batch dispatches on ``shard``'s device — and so LOSING that
+        chip degrades instead of erroring: the first raise triggers one
+        failover re-verify of the same sets on another healthy shard
+        (or the default device when none is left). A failover that
+        SUCCEEDS proves the work was fine and the chip is the problem —
+        the shard is dropped from the axis (``shard_lost`` journaled,
+        planner stops packing onto it) and the verdict is the
+        failover's, so verdict identity holds. A failover that raises
+        the same way means the WORK is the problem: the shard keeps its
+        health and the exception propagates exactly as the pre-mesh
+        contract demands (bisection delivers it leaf by leaf)."""
+        mesh_mod = _mesh_module()
+        state = {"failed_over": False}
+
+        def run(sets):
+            target = shard
+            if state["failed_over"] or not mesh.is_healthy(shard):
+                target = mesh.failover_shard(shard)
+            if target is None:
+                return verify(sets)  # every chip lost: default device
+            t0 = time.monotonic()
+            try:
+                with mesh_mod.dispatch_to(target):
+                    out = verify(sets)
+            except BaseException as e:  # noqa: BLE001 — failover decides
+                if target != shard:
+                    raise  # the failover shard itself raised: real error
+                state["failed_over"] = True
+                return self._failover_retry(verify, sets, shard, e, mesh)
+            mesh.note_dispatch(target, len(sets), time.monotonic() - t0)
+            return out
+
+        return run
+
+    def _failover_retry(self, verify, sets, shard: int, err, mesh):
+        mesh_mod = _mesh_module()
+        fb = mesh.failover_shard(shard)
+        t0 = time.monotonic()
+        try:
+            if fb is not None:
+                with mesh_mod.dispatch_to(fb):
+                    out = verify(sets)
+            else:
+                out = verify(sets)
+        except BaseException:
+            # the failover failed the SAME work: the work, not the chip,
+            # is the problem — count the failure, keep the shard on the
+            # axis, surface the exception (pre-mesh contract)
+            mesh.note_failure(shard, err, lost=False)
+            raise
+        # failover verdict in hand: the chip is the problem — drop it
+        # (note_failure journals shard_lost on the healthy->lost
+        # transition) and the verdict stands
+        mesh.note_failure(shard, err, lost=True)
+        if fb is not None:
+            mesh.note_dispatch(fb, len(sets), time.monotonic() - t0)
+        return out
 
     # -- verdict resolution (split-and-retry isolation) -------------------
 
@@ -741,7 +957,8 @@ class VerificationScheduler:
     def _bisect(
         self, subs: List[_Submission], verify: Optional[Callable] = None
     ) -> bool:
-        self._bisections += 1
+        with self._lock:  # dp shard workers may bisect concurrently
+            self._bisections += 1
         _BISECTIONS.inc()
         flight_recorder.record(
             "scheduler_bisection",
@@ -815,6 +1032,7 @@ class VerificationScheduler:
         with self._lock:
             pending_subs = len(self._pending)
             pending_sets = self._pending_sets
+        mesh = _active_mesh()  # read the seam ONCE: stop() may null it
         return {
             "running": self.running(),
             "queue_submissions": pending_subs,
@@ -829,6 +1047,9 @@ class VerificationScheduler:
             "last_batch_occupancy": round(self._last_occupancy, 4),
             "buckets_seen": sorted(self._buckets_seen),
             "compile_service_attached": self._compile_service is not None,
+            "dp_shards": (
+                len(mesh.healthy_shards()) if mesh is not None else 0
+            ),
             "planner": {
                 "enabled": self._planner.enabled,
                 "overhead_lanes": self._planner.overhead_lanes,

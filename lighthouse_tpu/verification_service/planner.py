@@ -41,10 +41,25 @@ sub-batches:
   the per-batch fixed overhead the scheduler exists to amortize
   (docs/COST_MODEL.md) is not re-fragmented.
 
+* **shard the dp axis** (ISSUE 11) — with a served device mesh
+  attached (``crypto/device/mesh.py``), plans gain a second packing
+  axis: each kind group's submissions are balance-partitioned across
+  the mesh's healthy shards (whole submissions only) and bin-packed
+  per shard, so every shard's sub-batch is a kind-homogeneous batch
+  dispatched to its own chip. A shard is never given fewer than
+  ``dp_min_sets`` sets (trickle traffic must not be shredded across
+  chips just because chips exist), and a lost shard simply stops
+  appearing in ``shards`` — the axis degrades, the plan does not fail.
+  Scoring compares the *busiest shard's* padded lanes (shards run
+  concurrently; wall-clock is the max, not the sum) plus the dispatch
+  overhead charge against the legacy single rung.
+
 Submissions are ATOMIC: a submission is the verdict-isolation unit
 (split-and-retry bisection, batcher.py) and is never split across
-sub-batches — every plan covers every submission exactly once, pinned
-by ``tests/test_flush_planner.py``.
+sub-batches — every plan covers every submission exactly once, and the
+shard axis respects the same unit (a submission lands on exactly one
+shard), pinned by ``tests/test_flush_planner.py`` /
+``tests/test_dp_mesh.py``.
 
 This module also owns the ONE lane/padding-waste formula
 (:func:`padded_lanes` / :func:`live_lanes` /
@@ -70,8 +85,14 @@ Rung = Tuple[int, int, int]  # (B, K, M) padded bucket shape
 # the planner never shreds trickle traffic into tiny batches just to
 # shave a lane or two — the fusing win of the scheduler stays intact.
 DEFAULT_SUBBATCH_OVERHEAD_LANES = 16
+# Minimum sets a dp shard is worth waking up for: below this the
+# per-dispatch fixed overhead dominates whatever parallelism buys, so a
+# kind group smaller than 2x this stays on one shard (trickle keeps
+# fusing; the shard axis is for the big warm rungs, DP_SCALING.json).
+DEFAULT_DP_MIN_SETS = 8
 _ENV_OVERHEAD = "LIGHTHOUSE_TPU_SCHED_PLAN_OVERHEAD_LANES"
 _ENV_PLANNER = "LIGHTHOUSE_TPU_SCHED_PLANNER"
+_ENV_DP_MIN = "LIGHTHOUSE_TPU_SCHED_DP_MIN_SETS"
 
 
 # ---------------------------------------------------------------------------
@@ -156,13 +177,13 @@ class PlannedSubBatch:
 
     __slots__ = (
         "subs", "sets", "kinds", "n_sets", "k_req", "m_req",
-        "pk_slots", "rung", "cold", "static", "live", "padded",
+        "pk_slots", "rung", "cold", "static", "shard", "live", "padded",
         "est_h2d_bytes", "est_live_h2d_bytes",
     )
 
     def __init__(self, subs: List, rung: Rung, cold: bool,
                  n_sets: int, k_req: int, m_req: int, pk_slots: int,
-                 static: bool = False):
+                 static: bool = False, shard: Optional[int] = None):
         self.subs = subs
         self.sets = [st for s in subs for st in s.sets]
         self.kinds = "+".join(sorted({s.kind for s in subs}))
@@ -173,6 +194,9 @@ class PlannedSubBatch:
         self.rung = rung
         self.cold = cold
         self.static = static
+        # the dp shard this sub-batch dispatches on (ISSUE 11); None =
+        # unsharded (primary device) — the pre-mesh behavior
+        self.shard = shard
         self.live = live_lanes(pk_slots, m_req)
         self.padded = padded_lanes(*rung)
         # byte accounting (ISSUE 8): what the packer will ship
@@ -224,6 +248,13 @@ class FlushPlan:
             f"{b}x{k}x{m}" for (b, k, m) in (sb.rung for sb in self.sub_batches)
         )
 
+    def shards_used(self) -> List[int]:
+        """Distinct dp shards this plan dispatches on (empty when the
+        plan is unsharded — the single-device behavior)."""
+        return sorted({
+            sb.shard for sb in self.sub_batches if sb.shard is not None
+        })
+
 
 # ---------------------------------------------------------------------------
 # The planner
@@ -274,6 +305,7 @@ class FlushPlanner:
         self,
         overhead_lanes: Optional[int] = None,
         enabled: Optional[bool] = None,
+        dp_min_sets: Optional[int] = None,
     ):
         if overhead_lanes is None:
             try:
@@ -281,6 +313,12 @@ class FlushPlanner:
             except ValueError:
                 overhead_lanes = DEFAULT_SUBBATCH_OVERHEAD_LANES
         self.overhead_lanes = max(0, int(overhead_lanes))
+        if dp_min_sets is None:
+            try:
+                dp_min_sets = int(os.environ.get(_ENV_DP_MIN, ""))
+            except ValueError:
+                dp_min_sets = DEFAULT_DP_MIN_SETS
+        self.dp_min_sets = max(1, int(dp_min_sets))
         if enabled is None:
             enabled = os.environ.get(_ENV_PLANNER, "1") not in ("", "0")
         self.enabled = bool(enabled)
@@ -290,13 +328,24 @@ class FlushPlanner:
     def plan(
         self,
         subs: Sequence,
-        warm_rungs: Optional[Iterable[Rung]] = None,
+        warm_rungs=None,
+        shards: Optional[Sequence[int]] = None,
     ) -> FlushPlan:
         """Partition ``subs`` (objects with ``.kind`` and ``.sets``) into
         sub-batches. ``warm_rungs`` is the compile-service registry's
-        warm (B, K, M) set for the active engine — None means no service
-        attached (every exact rung dispatches; the packers pad to it)."""
-        warm = None if warm_rungs is None else list(warm_rungs)
+        warm (B, K, M) set for the active engine — a flat iterable, or
+        (mesh-aware, ISSUE 11) a ``{shard: [rungs]}`` dict so a COLD
+        shard sheds to fallback instead of stalling a flush; None means
+        no service attached (every exact rung dispatches; the packers
+        pad to it). ``shards`` is the mesh's healthy shard-id list —
+        more than one enables the dp packing axis; None/1 is the
+        single-device behavior, byte-identical to before."""
+        shard_ids = [int(s) for s in shards] if shards else []
+        dp = len(shard_ids) > 1
+        warm = warm_rungs
+        if warm is not None and not isinstance(warm, dict):
+            warm = list(warm)
+        legacy_warm = self._warm_for(warm, shard_ids[0] if shard_ids else None)
         table = _active_key_table()
         subs = list(subs)
         # classify each submission ONCE; the legacy whole-flush flag and
@@ -307,11 +356,18 @@ class FlushPlanner:
             for s in subs
         ]
         legacy = self._make_sub_batch(
-            subs, warm, table, static=bool(subs) and all(flags)
+            subs, legacy_warm, table, static=bool(subs) and all(flags),
+            shard=shard_ids[0] if shard_ids else None,
         )
         if not self.enabled or len(subs) == 0:
             return FlushPlan("single", [legacy], legacy.rung, legacy.cold)
-        planned = self._kind_binpacked(subs, flags, warm, table)
+        # shards are passed through even at width 1: a one-chip mesh
+        # still tags every sub-batch with its shard so per-chip
+        # accounting and failover behave uniformly (dp scoring below
+        # only engages at width > 1)
+        planned = self._kind_binpacked(
+            subs, flags, warm, table, shard_ids or None
+        )
         if len(planned) <= 1:
             # one bin == the legacy plan re-derived; report it as single
             # (same rung by construction: one group, one bin, whole flush)
@@ -341,9 +397,28 @@ class FlushPlanner:
             and len({sb.static for sb in planned}) > 1
             and not legacy.static
         )
-        score = sum(sb.padded for sb in planned) + self.overhead_lanes * (
-            len(planned) - 1
-        )
+        if dp and len({sb.shard for sb in planned}) > 1:
+            # shards run CONCURRENTLY: the wall-clock cost of a dp plan
+            # is the busiest shard's padded lanes (plus its extra
+            # dispatches), not the sum over shards — comparing the sum
+            # against one device's single rung would charge parallelism
+            # as if it were serial and the axis would never win
+            per_shard_padded: Dict[Optional[int], int] = {}
+            per_shard_count: Dict[Optional[int], int] = {}
+            for sb in planned:
+                per_shard_padded[sb.shard] = (
+                    per_shard_padded.get(sb.shard, 0) + sb.padded
+                )
+                per_shard_count[sb.shard] = (
+                    per_shard_count.get(sb.shard, 0) + 1
+                )
+            score = max(per_shard_padded.values()) + self.overhead_lanes * (
+                max(per_shard_count.values()) - 1
+            )
+        else:
+            score = sum(sb.padded for sb in planned) + self.overhead_lanes * (
+                len(planned) - 1
+            )
         if score >= legacy.padded and not static_split:
             return FlushPlan("single", [legacy], legacy.rung, legacy.cold)
         return FlushPlan("planned", planned, legacy.rung, legacy.cold)
@@ -370,14 +445,28 @@ class FlushPlanner:
         m_req = max(1, len(msgs) + distinct)
         return n, k_req, m_req, pk_slots
 
+    @staticmethod
+    def _warm_for(warm, shard: Optional[int]):
+        """The warm-rung set a sub-batch on ``shard`` routes against:
+        a per-shard dict (mesh-aware registry) keys by shard — an
+        unknown shard reads as COLD, never as another chip's warmth; a
+        flat list applies to every shard; None means no service."""
+        if isinstance(warm, dict):
+            if shard is None:
+                if not warm:
+                    return None
+                shard = sorted(warm)[0]
+            return list(warm.get(shard, ()))
+        return warm
+
     def _make_sub_batch(
         self, subs: List, warm: Optional[List[Rung]], table=None,
-        static: Optional[bool] = None,
+        static: Optional[bool] = None, shard: Optional[int] = None,
     ) -> PlannedSubBatch:
         """``static=None`` classifies here (the legacy whole-flush
         sub-batch); the bin-packer passes its group's already-known
         flag so a flush is classified once per submission, not re-walked
-        per bin."""
+        per bin. ``warm`` is already shard-resolved by the caller."""
         n, k_req, m_req, pk_slots = self._geometry_of(subs)
         exact: Rung = (
             round_up_bucket(max(1, n)),
@@ -395,7 +484,8 @@ class FlushPlanner:
         if static is None:
             static = bool(table is not None and self._is_static(subs, table))
         return PlannedSubBatch(
-            subs, rung, cold, n, k_req, m_req, pk_slots, static=static
+            subs, rung, cold, n, k_req, m_req, pk_slots, static=static,
+            shard=shard,
         )
 
     @staticmethod
@@ -410,48 +500,113 @@ class FlushPlanner:
             return False
 
     def _kind_binpacked(
-        self, subs: List, flags: List[bool], warm: Optional[List[Rung]],
-        table=None,
+        self, subs: List, flags: List[bool], warm,
+        table=None, shards: Optional[List[int]] = None,
     ) -> List[PlannedSubBatch]:
         """Sub-bucket by kind — and, with a device key table attached,
         by static/dynamic eligibility (``flags``, one per submission,
         classified once by ``plan``), so one out-of-table submission
-        cannot degrade a whole flush back to the raw limb plane — then
-        first-fit-decreasing bin-pack each group's submissions over the
-        B axis with bin capacity = the largest ladder rung <= the
-        group's set count (an oversized submission opens its own bin —
+        cannot degrade a whole flush back to the raw limb plane — then,
+        with a dp mesh (``shards``, ISSUE 11), balance-partition each
+        group across shards (whole submissions only; a shard never gets
+        fewer than ``dp_min_sets`` sets), then first-fit-decreasing
+        bin-pack each (group × shard)'s submissions over the B axis
+        with bin capacity = the largest ladder rung <= that partition's
+        set count (an oversized submission opens its own bin —
         submissions never split)."""
         groups: Dict[Tuple[str, bool], List] = {}
         for s, static in zip(subs, flags):
             groups.setdefault((s.kind, static), []).append(s)
         planned: List[PlannedSubBatch] = []
+        # cross-group shard load so small groups spread over the mesh
+        # instead of all landing on the first shard
+        shard_load: Dict[int, int] = {s: 0 for s in (shards or ())}
         for kind, _static in sorted(groups):
             members = groups[(kind, _static)]
             n_group = sum(len(s.sets) for s in members)
-            cap = _largest_rung_at_most(max(1, n_group))
-            # stable FFD: big submissions first, arrival order tie-break
-            order = sorted(
-                range(len(members)),
-                key=lambda i: (-len(members[i].sets), i),
-            )
-            bins: List[List] = []  # [submissions, set count]
-            for i in order:
-                sub = members[i]
-                size = len(sub.sets)
-                placed = False
-                for b in bins:
-                    if b[1] + size <= cap:
-                        b[0].append(sub)
-                        b[1] += size
-                        placed = True
-                        break
-                if not placed:
-                    # a submission larger than cap still gets its own bin
-                    bins.append([[sub], size])
-            for members_bin, _count in bins:
-                planned.append(
-                    self._make_sub_batch(
-                        members_bin, warm, table, static=_static
-                    )
+            if shards:
+                parts = self._dp_partition(
+                    members, n_group, shards, shard_load
                 )
+            else:
+                parts = [(None, members)]
+            for shard, part in parts:
+                n_part = sum(len(s.sets) for s in part)
+                cap = _largest_rung_at_most(max(1, n_part))
+                shard_warm = self._warm_for(warm, shard)
+                # stable FFD: big submissions first, arrival-order
+                # tie-break
+                order = sorted(
+                    range(len(part)),
+                    key=lambda i: (-len(part[i].sets), i),
+                )
+                bins: List[List] = []  # [submissions, set count]
+                for i in order:
+                    sub = part[i]
+                    size = len(sub.sets)
+                    placed = False
+                    for b in bins:
+                        if b[1] + size <= cap:
+                            b[0].append(sub)
+                            b[1] += size
+                            placed = True
+                            break
+                    if not placed:
+                        # a submission larger than cap still gets its
+                        # own bin
+                        bins.append([[sub], size])
+                for members_bin, _count in bins:
+                    planned.append(
+                        self._make_sub_batch(
+                            members_bin, shard_warm, table,
+                            static=_static, shard=shard,
+                        )
+                    )
         return planned
+
+    def _dp_partition(
+        self, members: List, n_group: int, shards: List[int],
+        shard_load: Dict[int, int],
+    ) -> List[Tuple[int, List]]:
+        """Partition one kind group's submissions across dp shards:
+        at most ``n_group // dp_min_sets`` shards participate (a shard
+        must be worth its dispatch overhead), chosen least-loaded
+        first; big submissions greedily land on the least-loaded chosen
+        shard. Deterministic (sorted, index tie-breaks) — the lockstep
+        replay's byte-identical-across-processes gate covers dp plans
+        too. Submissions NEVER split across shards."""
+        k = min(len(shards), max(1, n_group // self.dp_min_sets))
+        if k <= 1:
+            s = min(shards, key=lambda i: (shard_load[i], i))
+            shard_load[s] += n_group
+            return [(s, members)]
+        chosen = sorted(shards, key=lambda i: (shard_load[i], i))[:k]
+        buckets: Dict[int, List] = {s: [] for s in chosen}
+        local: Dict[int, int] = {s: 0 for s in chosen}
+        order = sorted(
+            range(len(members)), key=lambda i: (-len(members[i].sets), i)
+        )
+        for i in order:
+            sub = members[i]
+            s = min(chosen, key=lambda j: (local[j], j))
+            buckets[s].append(sub)
+            local[s] += len(sub.sets)
+        # enforce the floor AFTER the greedy pass: skewed atomic
+        # submissions (one 16-set + one 2-set) can leave a shard below
+        # dp_min_sets — merge it into the least-loaded other shard so
+        # no dispatch is ever worth less than the floor the knob
+        # documents. Terminates: every merge removes a bucket.
+        while len(buckets) > 1:
+            under = [s for s in buckets if local[s] < self.dp_min_sets]
+            if not under:
+                break
+            s = min(under, key=lambda j: (local[j], j))
+            tgt = min(
+                (t for t in buckets if t != s),
+                key=lambda j: (local[j], j),
+            )
+            buckets[tgt].extend(buckets.pop(s))
+            local[tgt] += local.pop(s)
+        for s, n in local.items():
+            shard_load[s] += n
+        return [(s, buckets[s]) for s in sorted(buckets) if buckets[s]]

@@ -399,6 +399,7 @@ def lockstep_replay(
     max_batch_sets: int = 256,
     planner: Optional[FlushPlanner] = None,
     warm_rungs: Optional[list] = None,
+    shards: Optional[list] = None,
 ) -> dict:
     """Deterministic virtual replay: walk the trace in arrival order and
     apply the scheduler's EXACT drain/flush policy (deadline measured
@@ -431,13 +432,14 @@ def lockstep_replay(
             subs.append(sub)
             n += len(sub.sets)
         pending_sets -= n
-        plan = planner.plan(subs, warm_rungs=warm_rungs)
+        plan = planner.plan(subs, warm_rungs=warm_rungs, shards=shards)
         flushes.append({
             "trigger": trigger,
             "n_submissions": len(subs),
             "n_sets": n,
             "mode": plan.mode,
             "rungs": plan.rungs_label(),
+            "dp_shards": plan.shards_used(),
             "live_lanes": plan.live,
             "padded_lanes": plan.padded,
             "waste": round(plan.waste(), 4),
@@ -448,6 +450,7 @@ def lockstep_replay(
                 {
                     "kinds": sb.kinds,
                     "rung": list(sb.rung),
+                    "shard": sb.shard,
                     "n_sets": sb.n_sets,
                     "pk_slots": sb.pk_slots,
                     "m_req": sb.m_req,

@@ -39,6 +39,12 @@ def _bench_doc(sets_per_sec, waste, wrapped=False, kt_bytes=45.0):
             "on": {"pubkeys_bytes_per_set": kt_bytes},
             "pubkeys_bytes_per_set_reduction": 1.0 - kt_bytes / 2100.0,
         },
+        # ISSUE 11: the served dp leg's 2-device aggregate is gated
+        "dp_leg": {
+            "dp1": {"sets_per_sec": sets_per_sec},
+            "dp2": {"sets_per_sec": sets_per_sec * 0.9},
+            "aggregate_speedup": 0.9,
+        },
     }
     return {"n": 1, "rc": 0, "parsed": doc} if wrapped else doc
 
@@ -73,7 +79,11 @@ def test_diff_exits_nonzero_on_regression(tmp_path):
     rep = bench_diff.diff(
         bench_diff.load_bench(old), bench_diff.load_bench(new)
     )
-    assert rep["regressions"] == ["headline_sets_per_sec"]
+    # the fixture's dp2 aggregate tracks the headline value, so the
+    # ISSUE 11 dp gate trips alongside the throughput gate
+    assert rep["regressions"] == [
+        "headline_sets_per_sec", "dp2_sets_per_sec",
+    ]
     # >20% padding-waste growth trips the other gate
     worse = _write(tmp_path, "c.json", _bench_doc(10.0, 0.65))
     assert bench_diff.main([old, worse]) == 1

@@ -1,0 +1,630 @@
+"""Served multi-chip data-parallel verify (ISSUE 11): the dp shard
+axis of the flush planner, the scheduler's concurrent per-shard
+dispatch + chip-loss failover, and the mesh health surface — all at the
+scheduling layer (placeholder devices, no jax dispatch; the real
+staged-device acceptance lives in tests/test_zgate8_multichip.py).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from lighthouse_tpu.crypto.device import mesh as mesh_mod
+from lighthouse_tpu.utils import flight_recorder
+from lighthouse_tpu.verification_service import VerificationScheduler
+from lighthouse_tpu.verification_service.planner import FlushPlanner
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class _Sub:
+    __slots__ = ("kind", "sets")
+
+    def __init__(self, kind, sets):
+        self.kind = kind
+        self.sets = sets
+
+
+def _mk_sets(kind, n, pubkeys=1, messages=2):
+    return [
+        (None, [None] * pubkeys,
+         kind.encode() + (i % messages).to_bytes(4, "big"))
+        for i in range(n)
+    ]
+
+
+@pytest.fixture
+def mesh2():
+    m = mesh_mod.DeviceMesh(devices=[None, None])
+    mesh_mod.set_mesh(m)
+    yield m
+    mesh_mod.clear_mesh(m)
+
+
+# ---------------------------------------------------------------------------
+# Planner: the dp shard axis
+# ---------------------------------------------------------------------------
+
+
+def test_dp_plan_covers_every_submission_once_and_never_splits():
+    """The atomic-isolation property EXTENDED to the shard axis: over
+    random traffic and random shard sets, every submission appears in
+    exactly one sub-batch on exactly one shard."""
+    rng = random.Random(0xD0)
+    planner = FlushPlanner(dp_min_sets=4)
+    kinds = ("unaggregated", "aggregate", "sync_message")
+    for _round in range(40):
+        subs = [
+            _Sub(rng.choice(kinds),
+                 _mk_sets("k", rng.randint(1, 9), rng.randint(1, 4)))
+            for _ in range(rng.randint(1, 24))
+        ]
+        shards = sorted(rng.sample(range(6), rng.randint(1, 4)))
+        plan = planner.plan(subs, shards=shards)
+        seen = {}
+        for sb in plan.sub_batches:
+            assert sb.shard is None or sb.shard in shards
+            for s in sb.subs:
+                assert id(s) not in seen, "submission split across sub-batches"
+                seen[id(s)] = sb.shard
+        assert len(seen) == len(subs), "plan must cover every submission"
+
+
+def test_dp_plan_splits_headline_mix_across_shards():
+    """48-set headline mix on a 2-shard mesh: each kind group splits
+    across both shards, the busiest shard carries ~half the lanes, and
+    the dp score beats the legacy single rung."""
+    planner = FlushPlanner(dp_min_sets=8)
+    subs = [_Sub("unaggregated", _mk_sets("u", 1, 1)) for _ in range(32)]
+    subs += [_Sub("aggregate", _mk_sets("a", 1, 8)) for _ in range(16)]
+    plan = planner.plan(subs, shards=[0, 1])
+    assert plan.mode == "planned"
+    assert plan.shards_used() == [0, 1]
+    per_shard_sets = {}
+    for sb in plan.sub_batches:
+        per_shard_sets[sb.shard] = per_shard_sets.get(sb.shard, 0) + sb.n_sets
+    assert per_shard_sets == {0: 24, 1: 24}, per_shard_sets
+    # each shard got BOTH kinds (kind-homogeneous sub-batches per shard)
+    kinds_by_shard = {}
+    for sb in plan.sub_batches:
+        kinds_by_shard.setdefault(sb.shard, set()).add(sb.kinds)
+    assert kinds_by_shard[0] == kinds_by_shard[1] == {
+        "unaggregated", "aggregate",
+    }
+
+
+def test_dp_min_sets_keeps_trickle_on_one_shard():
+    """A trickle flush must not be shredded across chips just because
+    chips exist: below 2x dp_min_sets the group stays whole."""
+    planner = FlushPlanner(dp_min_sets=8)
+    subs = [_Sub("unaggregated", _mk_sets("u", 1, 1)) for _ in range(6)]
+    plan = planner.plan(subs, shards=[0, 1, 2, 3])
+    assert len(plan.shards_used()) <= 1
+
+
+def test_dp_min_sets_floor_holds_under_skewed_submissions():
+    """Skewed atomic submissions (one 16-set + one 2-set) must not
+    strand a 2-set dispatch on its own chip: the under-floor shard
+    merges away and the documented dp_min_sets floor holds for every
+    shard of every plan."""
+    planner = FlushPlanner(dp_min_sets=8)
+    subs = [
+        _Sub("backfill", _mk_sets("b", 16, 1)),
+        _Sub("backfill", _mk_sets("b", 2, 1)),
+    ]
+    plan = planner.plan(subs, shards=[0, 1])
+    per_shard = {}
+    for sb in plan.sub_batches:
+        per_shard[sb.shard] = per_shard.get(sb.shard, 0) + sb.n_sets
+    assert all(n >= 8 for n in per_shard.values()), per_shard
+    # and the property holds over random skew
+    rng = random.Random(0xF1)
+    for _ in range(30):
+        subs = [
+            _Sub("k", _mk_sets("k", rng.choice((1, 2, 3, 16, 24)), 1))
+            for _ in range(rng.randint(2, 10))
+        ]
+        plan = planner.plan(subs, shards=[0, 1, 2])
+        per_shard = {}
+        for sb in plan.sub_batches:
+            if sb.shard is not None:
+                per_shard[sb.shard] = (
+                    per_shard.get(sb.shard, 0) + sb.n_sets
+                )
+        if len(per_shard) > 1:
+            assert all(n >= 8 for n in per_shard.values()), per_shard
+
+
+def test_per_shard_warm_rungs_cold_shard_folds_back():
+    """Mesh-aware warm routing: when a split would land one shard COLD
+    while the legacy single rung is warm on the primary shard, the plan
+    falls back to the single rung (a plan must never trade warm device
+    dispatch for a CPU shed); when the whole mesh is cold the dp split
+    stands and dispatch-time decide_flush sheds per shard."""
+    planner = FlushPlanner(dp_min_sets=8)
+    subs = [_Sub("unaggregated", _mk_sets("u", 1, 1)) for _ in range(32)]
+    big = (64, 16, 8)
+    small = (16, 1, 2)
+    # shard 1 knows nothing: the split would go cold there
+    plan = planner.plan(
+        subs, warm_rungs={0: [big, small], 1: []}, shards=[0, 1]
+    )
+    assert plan.mode == "single"
+    assert not plan.sub_batches[0].cold
+    # both shards warm at the small rung: the split stands
+    plan = planner.plan(
+        subs, warm_rungs={0: [big, small], 1: [small]}, shards=[0, 1]
+    )
+    assert plan.mode == "planned"
+    assert all(not sb.cold for sb in plan.sub_batches)
+    # everything cold everywhere: dp split stands (legacy is cold too)
+    plan = planner.plan(subs, warm_rungs={0: [], 1: []}, shards=[0, 1])
+    assert all(sb.cold for sb in plan.sub_batches)
+
+
+def test_survivor_shard_warmth_drives_plan_after_loss():
+    """After a chip loss leaves only shard 1, plans must read shard 1's
+    OWN warm set — not device 0's: a rung warm only on the dead chip
+    must not keep luring splits into permanent fallback sheds, and a
+    rung organically warm on the survivor must route warm."""
+    planner = FlushPlanner(dp_min_sets=8)
+    subs = [_Sub("unaggregated", _mk_sets("u", 1, 1)) for _ in range(8)]
+    rung = (8, 1, 2)
+    # survivor (shard 1) warm: the plan lands warm on shard 1
+    plan = planner.plan(subs, warm_rungs={1: [rung]}, shards=[1])
+    assert all(sb.shard == 1 and not sb.cold for sb in plan.sub_batches)
+    # only the DEAD device 0 warm: shard 1 must plan cold (sheds at
+    # dispatch) rather than borrow the dead chip's warmth
+    plan = planner.plan(subs, warm_rungs={0: [rung], 1: []}, shards=[1])
+    assert all(sb.shard == 1 and sb.cold for sb in plan.sub_batches)
+
+
+def test_rate_window_uses_window_length_not_first_sample(mesh2):
+    """One burst after idle must read as sets-per-WINDOW, not
+    sets-per-instant: the denominator is the rolling window length
+    (capped by mesh age), never the span since the burst itself."""
+    mesh2._t0 -= 120.0  # mesh has been alive for two windows
+    mesh2.note_dispatch(0, 30, 0.01)
+    rate = mesh2.status()["chips"][0]["sets_per_sec"]
+    assert rate == pytest.approx(30 / 60.0, rel=0.1), rate
+
+
+def test_lockstep_replay_dp_plans_are_deterministic():
+    from lighthouse_tpu.verification_service import traffic
+
+    events = traffic.gossip_steady(duration_s=6.0, seed=11)
+    a = traffic.lockstep_replay(events, shards=[0, 1])
+    b = traffic.lockstep_replay(events, shards=[0, 1])
+    assert a["digest"] == b["digest"]
+    assert any(fl["dp_shards"] == [0, 1] for fl in a["flushes"]), (
+        "a gossip-steady trace must produce at least one dp-sharded flush"
+    )
+
+
+# ---------------------------------------------------------------------------
+# Mesh: health + accounting
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_health_transitions_and_status(mesh2):
+    assert mesh2.healthy_shards() == [0, 1]
+    assert mesh2.primary_shard() == 0
+    assert mesh2.failover_shard(0) == 1
+    mesh2.note_dispatch(1, 8, 0.01)
+    st = mesh2.status()
+    assert st["n_devices"] == 2
+    assert st["chips"][1]["sets_total"] == 8
+    # loss: only the healthy->lost transition journals
+    err = RuntimeError("chip gone")
+    assert mesh2.note_failure(1, err, lost=True) is True
+    assert mesh2.note_failure(1, err, lost=True) is False
+    assert mesh2.healthy_shards() == [0]
+    assert mesh2.status()["lost_shards"] == [1]
+    assert mesh2.failover_shard(1) == 0
+    if flight_recorder.enabled():
+        lost = flight_recorder.events(["shard_lost"])
+        assert lost and lost[-1]["fields"]["shard"] == 1
+    # a non-chip failure (failover also failed) keeps the shard
+    assert mesh2.note_failure(0, err, lost=False) is False
+    assert mesh2.healthy_shards() == [0]
+    # operator restore puts the chip back on the axis
+    mesh2.restore_shard(1)
+    assert mesh2.healthy_shards() == [0, 1]
+
+
+def test_dispatch_to_sets_thread_local_shard(mesh2):
+    assert mesh_mod.current_shard() is None
+    with mesh_mod.dispatch_to(1):
+        assert mesh_mod.current_shard() == 1
+        with mesh_mod.dispatch_to(0):
+            assert mesh_mod.current_shard() == 0
+        assert mesh_mod.current_shard() == 1
+    assert mesh_mod.current_shard() is None
+
+
+# ---------------------------------------------------------------------------
+# Scheduler: concurrent sharded dispatch + chip-loss degradation
+# ---------------------------------------------------------------------------
+
+
+def _feed(sched, subs):
+    futs = [None] * len(subs)
+
+    def one(i):
+        futs[i] = sched.submit(subs[i][1], subs[i][0])
+
+    threads = [
+        threading.Thread(target=one, args=(i,)) for i in range(len(subs))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return [f.result(timeout=60) for f in futs]
+
+
+def test_scheduler_dispatches_on_both_shards_concurrently(mesh2):
+    """A 2-shard plan's sub-batches run in PARALLEL: a sleepy backend
+    overlaps its shard sleeps, and both shards account dispatches."""
+    shard_calls = {0: 0, 1: 0}
+    lock = threading.Lock()
+
+    def verify(sets):
+        s = mesh_mod.current_shard()
+        with lock:
+            shard_calls[s] = shard_calls.get(s, 0) + 1
+        time.sleep(0.005 * len(sets))
+        return True
+
+    n = 32
+    sched = VerificationScheduler(
+        verify_fn=verify, deadline_ms=10_000.0, max_batch_sets=n,
+        flush_planner=FlushPlanner(dp_min_sets=8),
+    ).start()
+    try:
+        subs = [("unaggregated", _mk_sets("u", 1, 1)) for _ in range(n)]
+        t0 = time.perf_counter()
+        assert all(_feed(sched, subs))
+        dp_wall = time.perf_counter() - t0
+    finally:
+        sched.stop()
+    assert shard_calls[0] >= 1 and shard_calls[1] >= 1, shard_calls
+    st = mesh2.status()
+    assert all(c["sets_total"] > 0 for c in st["chips"])
+    # both shards slept concurrently: the wall is well under the serial
+    # sum (32 x 5 ms = 160 ms; parallel halves the sleep component —
+    # generous margin for a contended box)
+    assert dp_wall < 0.150, dp_wall
+    assert sched.status()["dp_shards"] == 2
+
+
+def test_shard_loss_mid_replay_degrades_and_preserves_verdicts(mesh2):
+    """Kill shard 1 mid-replay: the in-flight sub-batch re-resolves on
+    the survivor with verdict identity (a poisoned submission is still
+    the ONLY one rejected), `shard_lost` is journaled, and the next
+    flush plans onto fewer shards."""
+    poison = _mk_sets("p", 1, 1)
+    kill = {"armed": False}
+
+    def verify(sets):
+        if kill["armed"] and mesh_mod.current_shard() == 1:
+            raise RuntimeError("injected chip loss")
+        return not any(s is poison[0] for s in sets)
+
+    n = 32
+    sched = VerificationScheduler(
+        verify_fn=verify, deadline_ms=10_000.0, max_batch_sets=n,
+        flush_planner=FlushPlanner(dp_min_sets=8),
+    ).start()
+    try:
+        # round 1: healthy mesh, both shards serve
+        subs = [("unaggregated", _mk_sets("u", 1, 1)) for _ in range(n)]
+        assert all(_feed(sched, subs))
+        assert mesh2.healthy_shards() == [0, 1]
+        # round 2: shard 1 dies mid-flush; one poisoned submission rides
+        # along and must be the only False
+        kill["armed"] = True
+        subs = [("unaggregated", _mk_sets("u", 1, 1)) for _ in range(n - 1)]
+        subs.append(("unaggregated", poison))
+        results = _feed(sched, subs)
+        assert results[:-1] == [True] * (n - 1)
+        assert results[-1] is False
+        assert mesh2.healthy_shards() == [0], "shard 1 must be dropped"
+        if flight_recorder.enabled():
+            assert flight_recorder.events(["shard_lost"]), (
+                "chip loss must be journaled"
+            )
+        # round 3: the node keeps serving — the plan drops the shard
+        # axis entry (single healthy shard left)
+        subs = [("unaggregated", _mk_sets("u", 1, 1)) for _ in range(n)]
+        assert all(_feed(sched, subs))
+        last = sched.status()["planner"]["last_plan"]
+        assert last["dp_shards"] in ([], [0]), last
+        assert sched.status()["dp_shards"] == 1
+    finally:
+        sched.stop()
+
+
+def test_failover_failure_propagates_and_keeps_shard(mesh2):
+    """When the failover re-verify raises the SAME way, the work — not
+    the chip — is the problem: the exception reaches exactly the leaf
+    submissions (pre-mesh contract) and the shard stays on the axis."""
+    def verify(sets):
+        raise ValueError("deterministic backend bug")
+
+    n = 16
+    sched = VerificationScheduler(
+        verify_fn=verify, deadline_ms=10_000.0, max_batch_sets=n,
+        flush_planner=FlushPlanner(dp_min_sets=4),
+    ).start()
+    try:
+        subs = [("unaggregated", _mk_sets("u", 1, 1)) for _ in range(n)]
+        futs = [None] * len(subs)
+
+        def one(i):
+            futs[i] = sched.submit(subs[i][1], subs[i][0])
+
+        threads = [
+            threading.Thread(target=one, args=(i,))
+            for i in range(len(subs))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for f in futs:
+            with pytest.raises(ValueError):
+                f.result(timeout=60)
+    finally:
+        sched.stop()
+    assert mesh2.healthy_shards() == [0, 1], (
+        "a deterministic work failure must not cost a chip"
+    )
+
+
+def test_verify_now_reroutes_to_surviving_shard(mesh2):
+    """The latency-critical bypass follows the mesh's primary healthy
+    shard — after shard 0 is lost it dispatches on shard 1."""
+    seen = []
+
+    def verify(sets):
+        seen.append(mesh_mod.current_shard())
+        return True
+
+    sched = VerificationScheduler(
+        verify_fn=verify, deadline_ms=10_000.0, max_batch_sets=64,
+    ).start()
+    try:
+        assert sched.verify_now(_mk_sets("b", 2, 1), "block") is True
+        assert seen[-1] == 0
+        mesh2.note_failure(0, RuntimeError("gone"), lost=True)
+        assert sched.verify_now(_mk_sets("b", 2, 1), "block") is True
+        assert seen[-1] == 1
+    finally:
+        sched.stop()
+
+
+def test_verify_now_warm_check_consults_dispatching_shard(mesh2):
+    """The bypass's cold-bucket protection must route against the chip
+    that will ACTUALLY dispatch: after shard 0 is lost, a rung warm
+    only on the dead device 0 must shed to the fallback (not stall the
+    block path on shard 1's cold compile), and a rung warm on the
+    survivor must dispatch there directly."""
+    from lighthouse_tpu import compile_service as cs_mod
+    from lighthouse_tpu.compile_service import CompileService
+
+    dispatched = []
+
+    def verify(sets):
+        dispatched.append(mesh_mod.current_shard())
+        return True
+
+    fallback_calls = []
+
+    def fallback(sets):
+        fallback_calls.append(len(sets))
+        return True
+
+    svc = CompileService(
+        rungs=((1, 1, 1),),
+        compile_rung_fn=lambda b, k, m: {},  # never used: no worker work
+        fallback_verify_fn=fallback,
+    ).start()
+    cs_mod.set_service(svc)
+    sched = VerificationScheduler(
+        verify_fn=verify, deadline_ms=10_000.0, max_batch_sets=64,
+        compile_service=svc,
+    ).start()
+    try:
+        from lighthouse_tpu.crypto.device import fp
+
+        impl = fp.get_impl()
+        rung = (2, 1, 1)
+        sets = _mk_sets("b", 2, 1, messages=1)
+        mesh2.note_failure(0, RuntimeError("chip gone"), lost=True)
+        # warm ONLY on the dead device 0: the survivor is cold — shed
+        svc.registry.mark_ready(rung, impl, device=0)
+        assert sched.verify_now(sets, "block") is True
+        assert fallback_calls and not dispatched, (
+            fallback_calls, dispatched,
+        )
+        # now warm on the survivor too: direct dispatch on shard 1
+        svc.registry.mark_ready(rung, impl, device=1)
+        assert sched.verify_now(sets, "block") is True
+        assert dispatched and dispatched[-1] == 1
+    finally:
+        sched.stop()
+        svc.stop()
+        cs_mod.clear_service(svc)
+
+
+def test_gossip_steady_replay_dp2_holds_slo_and_beats_one_device(mesh2):
+    """The scheduling half of the ISSUE 11 acceptance criterion: a
+    gossip-steady trace replayed through the live scheduler on a
+    2-shard mesh holds every caller class's SLO (zero misses at a sane
+    deadline), keeps tail latency no worse than single-device, and
+    accounts throughput on both chips — measured with a deterministic
+    per-set-cost backend so the comparison isolates the dp axis. The
+    aggregate-beats-one-device wall-clock claim is pinned by
+    ``test_scheduler_dispatches_on_both_shards_concurrently`` (parallel
+    shard sleeps) and the staged-device half by
+    ``tests/test_zgate8_multichip.py``."""
+    from lighthouse_tpu.verification_service import traffic
+    from tools.traffic_replay import make_stub_verify, run_timed_replay
+
+    events = traffic.gossip_steady(duration_s=3.0, seed=9)
+
+    def replay():
+        return run_timed_replay(
+            events,
+            verify_fn=make_stub_verify(0.002),
+            set_factory=traffic.synthetic_sets,
+            deadline_ms=150.0,
+            time_scale=0.25,
+            max_workers=64,
+        )
+
+    # 2-shard mesh (the fixture) first, then single-device (no mesh)
+    rep_dp = replay()
+    mesh_mod.clear_mesh(mesh2)
+    rep_1 = replay()
+    mesh_mod.set_mesh(mesh2)  # fixture teardown expects it attached
+    for rep in (rep_dp, rep_1):
+        assert rep["verdicts"]["error"] == 0
+        assert rep["verdicts"]["invalid"] == 0
+    # per-class SLO held on the dp run: no kind misses its budget
+    for kind, rec in rep_dp["slo"]["kinds"].items():
+        assert rec["window_miss_ratio"] == 0.0, (kind, rec)
+    # dp aggregate beats single-device: with concurrent shard dispatch
+    # the same arrivals resolve faster end-to-end (p99 across kinds)
+    p99_dp = max(r["p99_ms"] for r in rep_dp["slo"]["kinds"].values())
+    p99_1 = max(r["p99_ms"] for r in rep_1["slo"]["kinds"].values())
+    assert p99_dp <= p99_1 * 1.25, (p99_dp, p99_1)
+    st = mesh2.status()
+    assert st["aggregate_sets_per_sec"] > 0
+    assert sum(c["sets_total"] for c in st["chips"]) >= rep_dp["n_sets"] // 2
+
+
+# ---------------------------------------------------------------------------
+# Key-table replication (the all-or-nothing contract spans the mesh)
+# ---------------------------------------------------------------------------
+
+
+def test_key_table_replicates_per_shard_all_or_nothing(mesh2):
+    """With a 2-shard mesh attached, the device key table mirrors onto
+    BOTH shards: startup + delta syncs commit on every replica or none,
+    the resolve path serves the dispatch shard's replica, and upload
+    accounting counts per replica."""
+    import types
+
+    import numpy as np
+
+    from lighthouse_tpu.crypto import bls as host_bls
+    from lighthouse_tpu.crypto.device import key_table as kt
+
+    pks = [
+        types.SimpleNamespace(point=host_bls.SecretKey(31_000 + i).public_key().point)
+        for i in range(3)
+    ]
+    cache = types.SimpleNamespace(pubkeys=list(pks))
+    table = kt.DeviceKeyTable(cache, max_aggregates=4)
+    added = table.sync(reason="startup")
+    assert added == 3
+    st = table.status()
+    assert st["replicas"] == [0, 1]
+    # per-replica upload accounting: 3 rows x 2 replicas
+    assert st["upload_bytes"]["startup"] == 3 * kt.G1_ROW_BYTES * 2
+    # both replicas hold identical rows
+    d0, a0 = table.device_arrays(0)
+    d1, a1 = table.device_arrays(1)
+    assert d0 is not d1
+    np.testing.assert_array_equal(np.asarray(d0[:3]), np.asarray(d1[:3]))
+    assert a0 is not None and a1 is not None
+    # the resolve path serves the CURRENT dispatch shard's replica
+    sets = [(None, [pks[0].point, pks[1].point], b"m" * 32)]
+    with mesh_mod.dispatch_to(1):
+        res = table.resolve_sets(sets)
+    assert res is not None
+    _resolved, dev, _agg, _coll = res
+    assert dev is d1
+    # delta admission commits on every replica
+    cache.pubkeys.append(
+        types.SimpleNamespace(point=host_bls.SecretKey(31_900).public_key().point)
+    )
+    assert table.sync(reason="delta") == 1
+    d0b, _ = table.device_arrays(0)
+    d1b, _ = table.device_arrays(1)
+    np.testing.assert_array_equal(np.asarray(d0b[3]), np.asarray(d1b[3]))
+    assert not np.asarray(d0b[3] == 0).all()
+    # aggregate-sum inserts upload to EVERY replica and count bytes per
+    # replica (the sync path's accounting contract, applied here too):
+    # the second sighting of the committee tuple inserts the row
+    committee = [(None, [pks[0].point, pks[1].point], b"c" * 32)]
+    assert table.resolve_sets(committee) is not None
+    assert table.resolve_sets(committee) is not None
+    st = table.status()
+    assert st["aggregate_inserts"] == 1, st
+    assert st["upload_bytes"]["aggregate"] == kt.G1_ROW_BYTES * 2, st
+
+
+# ---------------------------------------------------------------------------
+# Tools
+# ---------------------------------------------------------------------------
+
+
+def test_flush_plan_report_devices_stays_jax_free():
+    """``--devices`` rendering must not pull jax in (subprocess pin,
+    same discipline as the base tool)."""
+    code = (
+        "import sys\n"
+        "import tools.flush_plan_report as t\n"
+        "rc = t.main(['--mix', 'unaggregated:32:1,aggregate:16:8',"
+        " '--devices', '2', '--json'])\n"
+        "assert rc == 0\n"
+        "assert 'jax' not in sys.modules, 'tool must stay jax-free'\n"
+    )
+    r = subprocess.run(
+        [sys.executable, "-c", code], cwd=REPO,
+        capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr
+    import json
+
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["devices"] == 2
+    assert rec["dp_shards"] == [0, 1]
+    assert len(rec["per_shard"]) == 2
+    assert all(sb["shard"] in (0, 1) for sb in rec["sub_batches"])
+
+
+def test_traffic_replay_dp_kill_shard_cli():
+    """CLI e2e: a dp replay with an injected chip loss keeps every
+    verdict ok and reports the degraded mesh."""
+    import json
+
+    # time-scale compresses the whole 3 s trace into ~0.3 s so every
+    # deadline flush accumulates well past 2 x dp_min_sets and MUST
+    # split across both shards; --kill-after 0 arms the loss from the
+    # first dispatch — shard 1's first sub-batch fails over
+    # deterministically whatever the box's scheduling jitter
+    r = subprocess.run(
+        [sys.executable, os.path.join("tools", "traffic_replay.py"),
+         "--generate", "gossip_steady", "--seed", "5", "--duration", "3",
+         "--dp", "2", "--kill-shard", "1", "--kill-after", "0",
+         "--verify", "stub:0.001", "--deadline-ms", "100",
+         "--time-scale", "0.1", "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=180,
+    )
+    assert r.returncode == 0, r.stderr
+    rep = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rep["verdicts"]["error"] == 0
+    assert rep["verdicts"]["invalid"] == 0
+    assert rep["mesh"]["lost_shards"] == [1]
+    assert rep["mesh"]["healthy_shards"] == [0]

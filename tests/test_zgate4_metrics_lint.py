@@ -64,6 +64,7 @@ def _import_instrumented_modules():
     import lighthouse_tpu.compile_service.service  # noqa: F401
     import lighthouse_tpu.crypto.device.bls  # noqa: F401
     import lighthouse_tpu.crypto.device.key_table  # noqa: F401
+    import lighthouse_tpu.crypto.device.mesh  # noqa: F401
     import lighthouse_tpu.http_api.server  # noqa: F401
     import lighthouse_tpu.utils.flight_recorder  # noqa: F401
     import lighthouse_tpu.utils.logging  # noqa: F401
@@ -285,6 +286,53 @@ def test_key_table_families_registered():
     assert list(lad) == sorted(set(lad))
 
 
+def test_dp_mesh_families_registered():
+    """ISSUE 11 families (crypto/device/mesh.py + the scheduler's dp
+    counters) exist under their declared types + labels, and the mesh
+    module stays importable jax-free."""
+    _import_instrumented_modules()
+    reg = metrics.registry_snapshot()
+    want = {
+        "bls_device_shard_sets_total": ("counter", ("shard",)),
+        "bls_device_shard_verify_seconds": ("histogram", ("shard",)),
+        "bls_device_shard_failures_total": ("counter", ("shard",)),
+        "bls_device_shard_health": ("gauge", ("shard",)),
+        "bls_device_shard_memory_bytes": ("gauge", ("shard",)),
+        "verification_scheduler_dp_shards": ("gauge", None),
+        "verification_scheduler_dp_subbatches_total": (
+            "counter", ("shard",),
+        ),
+        "verification_scheduler_dp_sets_total": ("counter", ("shard",)),
+    }
+    for name, (kind, labels) in want.items():
+        m = reg.get(name)
+        assert m is not None, f"family {name} not registered"
+        assert m.kind == kind, (name, m.kind)
+        if labels is not None:
+            assert m.labelnames == labels, (name, m.labelnames)
+        else:
+            assert not hasattr(m, "labelnames"), name  # unlabeled family
+    # jax-free import is subprocess-pinned (a mesh of placeholder
+    # devices must never initialize a backend)
+    import os
+    import subprocess
+    import sys
+
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import sys\n"
+         "from lighthouse_tpu.crypto.device import mesh\n"
+         "m = mesh.DeviceMesh(devices=[None, None])\n"
+         "assert m.healthy_shards() == [0, 1]\n"
+         "with mesh.dispatch_to(0):\n"
+         "    pass\n"
+         "assert 'jax' not in sys.modules, 'mesh must stay jax-free'\n"],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr
+
+
 def test_warmup_tool_imports_and_dry_run_lists_ladder(capsys, monkeypatch):
     """ISSUE 5 CI satellite: ``tools/warmup.py`` must import cleanly and
     ``--dry-run`` must list the ladder walk WITHOUT compiling anything
@@ -314,6 +362,16 @@ def test_warmup_tool_imports_and_dry_run_lists_ladder(capsys, monkeypatch):
     out = capsys.readouterr().out
     assert "B=4 K=1 M=1" in out
     assert "gather B=4 K=1" in out
+    # ISSUE 11: --devices renders the mesh ladder — rung x device,
+    # headline rungs first across every chip (still compile-free: the
+    # boobytrap above is live for this call too)
+    assert warmup.main(
+        ["--dry-run", "--devices", "2", "--rungs", "4:1:1,64:16:8"]
+    ) == 0
+    out = capsys.readouterr().out
+    assert "mesh ladder walk (2 rungs x 2 devices" in out
+    assert out.index("B=4 K=1 M=1 dev=0") < out.index("B=4 K=1 M=1 dev=1")
+    assert out.index("B=4 K=1 M=1 dev=1") < out.index("B=64 K=16 M=8 dev=0")
 
 
 def test_trace_schema_version_and_generators_documented():

@@ -63,14 +63,21 @@ METRICS = (
      ("key_table_leg", "on", "pubkeys_bytes_per_set"), False),
     ("key_table_reduction",
      ("key_table_leg", "pubkeys_bytes_per_set_reduction"), True),
+    # ISSUE 11: the served dp leg — the 2-device AGGREGATE sets/s is
+    # gated (a regression means the shard axis stopped delivering);
+    # the 1-device leg and the speedup ratio ride along ungated
+    ("dp1_sets_per_sec", ("dp_leg", "dp1", "sets_per_sec"), True),
+    ("dp2_sets_per_sec", ("dp_leg", "dp2", "sets_per_sec"), True),
+    ("dp_aggregate_speedup", ("dp_leg", "aggregate_speedup"), True),
 )
 
 # the metrics whose regression exits nonzero (ISSUE 8 throughput/waste
-# gates + the ISSUE 10 key-table bytes gate)
+# gates + the ISSUE 10 key-table bytes gate + the ISSUE 11 dp gate)
 GATED = (
     "headline_sets_per_sec",
     "headline_padding_waste",
     "key_table_pubkeys_bytes_per_set",
+    "dp2_sets_per_sec",
 )
 
 

@@ -346,6 +346,20 @@ def main() -> None:
       "`replay_leg` in the bench JSON "
       "([TRAFFIC_REPLAY.md](TRAFFIC_REPLAY.md); families in "
       "[OBSERVABILITY.md](OBSERVABILITY.md)).")
+    w("- Per-chip scaling (ISSUE 11): every table above prices ONE "
+      "chip, and the dp mesh multiplies it — flush plans gain a "
+      "(dp_shard × rung) axis, each shard's kind-homogeneous sub-batch "
+      "verifies on its own device, and aggregate sets/s is the SUM of "
+      "per-chip rates at the busiest-shard wall-clock (shards run "
+      "concurrently, so the planner scores the busiest shard's padded "
+      "lanes, not the lane sum). The committee batch-verification cost "
+      "model (arxiv 2302.00418) compounds with parallel lanes exactly "
+      "at the big warm rungs the mesh serves (B=256/512, "
+      "DP_SCALING.json); losing a chip degrades the multiplier by one "
+      "instead of zeroing it ([MULTICHIP.md](MULTICHIP.md); per-chip "
+      "`bls_device_shard_*` families and the `/lighthouse/health` "
+      "`mesh` block in [OBSERVABILITY.md](OBSERVABILITY.md); 1-vs-2 "
+      "device measurements in the bench `dp_leg`).")
     w("- Setup cost, not in these tables: the FIRST dispatch of each "
       "staged program at a fresh bucket shape pays the XLA compile "
       "(~120 s for the B=64 headline rung on this host, BENCH_r05 / the "

@@ -152,6 +152,21 @@ def main(argv=None) -> int:
         "(default: LIGHTHOUSE_TPU_SCHED_PLAN_OVERHEAD_LANES or 16)",
     )
     ap.add_argument(
+        "--devices",
+        type=int,
+        default=1,
+        help="dp mesh width (ISSUE 11): >1 renders the (dp x rung) "
+        "sharded plan with per-shard padded-lane accounting; --warm "
+        "applies to every shard (default 1 = single-device)",
+    )
+    ap.add_argument(
+        "--dp-min-sets",
+        type=int,
+        default=None,
+        help="minimum sets per dp shard (default: "
+        "LIGHTHOUSE_TPU_SCHED_DP_MIN_SETS or 8)",
+    )
+    ap.add_argument(
         "--json", action="store_true", help="print one summary JSON line"
     )
     args = ap.parse_args(argv)
@@ -171,18 +186,48 @@ def main(argv=None) -> int:
     # relies on; tests/test_flush_planner.py pins it in a subprocess)
     from lighthouse_tpu.verification_service import planner as planner_mod
 
+    if args.devices <= 0:
+        raise SystemExit("--devices must be positive")
     warm = _parse_warm(args.warm) if args.warm else None
+    shards = list(range(args.devices)) if args.devices > 1 else None
     subs = build_submissions(mix, args.sets_per_submission)
-    planner = planner_mod.FlushPlanner(overhead_lanes=args.overhead_lanes)
-    plan = planner.plan(subs, warm_rungs=warm)
+    planner = planner_mod.FlushPlanner(
+        overhead_lanes=args.overhead_lanes, dp_min_sets=args.dp_min_sets
+    )
+    plan = planner.plan(subs, warm_rungs=warm, shards=shards)
 
     n_sets = sum(len(s.sets) for s in subs)
+    # per-shard accounting (ISSUE 11): what each chip pays — the dp
+    # plan's wall-clock story is the BUSIEST shard, not the lane sum
+    per_shard = {}
+    for sb in plan.sub_batches:
+        if sb.shard is None:
+            continue
+        row = per_shard.setdefault(
+            sb.shard,
+            {"shard": sb.shard, "n_sub_batches": 0, "n_sets": 0,
+             "live_lanes": 0, "padded_lanes": 0},
+        )
+        row["n_sub_batches"] += 1
+        row["n_sets"] += sb.n_sets
+        row["live_lanes"] += sb.live
+        row["padded_lanes"] += sb.padded
+    for row in per_shard.values():
+        row["padding_waste"] = round(
+            planner_mod.padding_waste_ratio(
+                row["live_lanes"], row["padded_lanes"]
+            ), 4,
+        )
     record = {
         "n_sets": n_sets,
         "n_submissions": len(subs),
         "kinds": sorted({s.kind for s in subs}),
         "mode": plan.mode,
+        "devices": args.devices,
+        "dp_shards": plan.shards_used(),
+        "per_shard": [per_shard[s] for s in sorted(per_shard)],
         "overhead_lanes": planner.overhead_lanes,
+        "dp_min_sets": planner.dp_min_sets,
         "warm_rungs": None if warm is None else [list(r) for r in warm],
         "legacy_rung": list(plan.legacy_rung),
         "legacy_padded_lanes": plan.legacy_padded,
@@ -200,6 +245,7 @@ def main(argv=None) -> int:
                 "k_req": sb.k_req,
                 "m_req": sb.m_req,
                 "rung": list(sb.rung),
+                "shard": sb.shard,
                 "cold": sb.cold,
                 "live_lanes": sb.live,
                 "padded_lanes": sb.padded,
@@ -228,11 +274,18 @@ def main(argv=None) -> int:
     for i, sb in enumerate(plan.sub_batches):
         b, k, m = sb.rung
         cold = "  COLD (sheds to CPU fallback, rung demand-paged)" if sb.cold else ""
+        shard = "" if sb.shard is None else f" shard={sb.shard}"
         print(
             f"  {i + 1}. kind={sb.kinds:<24} n={sb.n_sets:>4} "
-            f"k={sb.k_req:>3} m={sb.m_req:>2} -> rung B={b} K={k} M={m}  "
-            f"live {sb.live:>6}  padded {sb.padded:>6}  "
+            f"k={sb.k_req:>3} m={sb.m_req:>2} -> rung B={b} K={k} M={m}"
+            f"{shard}  live {sb.live:>6}  padded {sb.padded:>6}  "
             f"waste {sb.waste():.4f}{cold}"
+        )
+    for row in record["per_shard"]:
+        print(
+            f"  shard {row['shard']}: {row['n_sub_batches']} sub-batches, "
+            f"{row['n_sets']} sets, live {row['live_lanes']} / padded "
+            f"{row['padded_lanes']} lanes, waste {row['padding_waste']}"
         )
     print(
         f"  total: live {plan.live} / padded {plan.padded} lanes, "
@@ -240,6 +293,12 @@ def main(argv=None) -> int:
         + (
             f"  (saves {plan.legacy_padded - plan.padded} lanes vs legacy)"
             if plan.mode == "planned"
+            else ""
+        )
+        + (
+            f"  busiest shard padded "
+            f"{max(r['padded_lanes'] for r in record['per_shard'])} lanes"
+            if record["per_shard"]
             else ""
         )
     )

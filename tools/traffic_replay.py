@@ -94,6 +94,32 @@ def wrap_slow_flush(verify, every: int, slow_s: float):
     return wrapped
 
 
+def wrap_kill_shard(verify, shard: int, after_calls: int):
+    """After ``after_calls`` backend calls, every dispatch that lands on
+    mesh shard ``shard`` raises — the injected mid-replay chip loss
+    (ISSUE 11). The scheduler's failover re-verifies the same sets on a
+    surviving shard, journals ``shard_lost``, and subsequent plans drop
+    the axis entry; verdicts stay identical because the re-verify IS
+    the verdict."""
+    from lighthouse_tpu.crypto.device import mesh as mesh_mod
+
+    lock = threading.Lock()
+    state = {"calls": 0, "killed": 0}
+
+    def wrapped(sets) -> bool:
+        with lock:
+            state["calls"] += 1
+            armed = state["calls"] > after_calls
+        if armed and mesh_mod.current_shard() == shard:
+            with lock:
+                state["killed"] += 1
+            raise RuntimeError(f"injected chip loss on shard {shard}")
+        return verify(sets)
+
+    wrapped.kill_state = state
+    return wrapped
+
+
 def make_crypto_set_factory():
     """Real-crypto payload builder for the native/device backends:
     per-(pubkeys) cached committees, aggregate signatures produced with
@@ -502,6 +528,25 @@ def main(argv=None) -> int:
     )
     run.add_argument("--stub-compile-s", type=float, default=0.25)
     run.add_argument(
+        "--dp", type=int, default=1,
+        help="served dp mesh width (ISSUE 11): >1 attaches a DeviceMesh "
+        "so flush plans shard (dp x rung) and sub-batches dispatch "
+        "concurrently — real jax devices for --verify device (virtual "
+        "mesh: XLA_FLAGS), placeholder devices (jax-free) for "
+        "stub/native",
+    )
+    run.add_argument(
+        "--kill-shard", type=int, default=None,
+        help="inject a chip loss: the given shard's dispatches raise "
+        "after --kill-after backend calls, exercising failover + "
+        "shard_lost degradation (needs --dp > 1)",
+    )
+    run.add_argument(
+        "--kill-after", type=int, default=None,
+        help="backend calls before --kill-shard arms (default: a third "
+        "of the trace's events; 0 = from the first dispatch)",
+    )
+    run.add_argument(
         "--no-planner", action="store_true",
         help="pin the legacy single-rung flush (every device flush "
         "resolves on the `fused` path)",
@@ -544,6 +589,7 @@ def main(argv=None) -> int:
         report = traffic.lockstep_replay(
             events, deadline_ms=args.deadline_ms,
             max_batch_sets=args.max_batch,
+            shards=list(range(args.dp)) if args.dp > 1 else None,
         )
         report["trace"] = {
             k: header.get(k) for k in ("name", "seed", "n_events")
@@ -564,22 +610,54 @@ def main(argv=None) -> int:
             svc = make_stub_compile_service(
                 verify_fn, compile_s=args.stub_compile_s
             )
-        report = run_timed_replay(
-            events,
-            verify_fn=verify_fn,
-            set_factory=set_factory,
-            deadline_ms=args.deadline_ms,
-            max_batch_sets=args.max_batch,
-            max_queue_sets=args.max_queue,
-            time_scale=args.time_scale,
-            compile_service=svc,
-            max_workers=args.workers,
-            plan_flushes=False if args.no_planner else None,
-        )
+        dmesh = None
+        if args.dp > 1:
+            from lighthouse_tpu.crypto.device import mesh as mesh_mod
+
+            if args.verify == "device":
+                dmesh = mesh_mod.DeviceMesh(n_devices=args.dp)
+            else:
+                # placeholder devices: the scheduler's shard axis and
+                # failover run for real (concurrent sub-batch dispatch,
+                # per-shard health) with zero jax — what the stub/native
+                # backends measure is scheduling parallelism
+                dmesh = mesh_mod.DeviceMesh(devices=[None] * args.dp)
+            mesh_mod.set_mesh(dmesh)
+        if args.kill_shard is not None:
+            if dmesh is None:
+                raise SystemExit("--kill-shard needs --dp > 1")
+            verify_fn = wrap_kill_shard(
+                verify_fn, args.kill_shard,
+                after_calls=(
+                    args.kill_after
+                    if args.kill_after is not None
+                    else max(1, len(events) // 3)
+                ),
+            )
+        try:
+            report = run_timed_replay(
+                events,
+                verify_fn=verify_fn,
+                set_factory=set_factory,
+                deadline_ms=args.deadline_ms,
+                max_batch_sets=args.max_batch,
+                max_queue_sets=args.max_queue,
+                time_scale=args.time_scale,
+                compile_service=svc,
+                max_workers=args.workers,
+                plan_flushes=False if args.no_planner else None,
+            )
+        finally:
+            if dmesh is not None:
+                from lighthouse_tpu.crypto.device import mesh as mesh_mod
+
+                mesh_mod.clear_mesh(dmesh)
+        report["mesh"] = None if dmesh is None else dmesh.status()
         report["trace"] = {
             k: header.get(k) for k in ("name", "seed", "n_events")
         }
         report["config"]["verify_backend"] = backend_name
+        report["config"]["dp"] = args.dp
 
     if args.out:
         with open(args.out, "w") as f:

@@ -62,6 +62,16 @@ def main(argv=None) -> int:
         "ladder plan, LIGHTHOUSE_TPU_COMPILE_RUNGS-overridable)",
     )
     ap.add_argument(
+        "--devices",
+        type=int,
+        default=1,
+        help="dp mesh width (ISSUE 11): the walk becomes the mesh "
+        "ladder — rung x device, headline rungs first across every "
+        "chip (default 1 = the single-device walk). A virtual mesh "
+        "needs XLA_FLAGS=--xla_force_host_platform_device_count=N set "
+        "before jax initializes",
+    )
+    ap.add_argument(
         "--dry-run",
         action="store_true",
         help="print the ladder walk in priority order and exit — no jax "
@@ -84,10 +94,28 @@ def main(argv=None) -> int:
     )
     cache_dir = cache_mod.resolve_cache_dir(args.cache_dir)
 
+    if args.devices <= 0:
+        raise SystemExit("--devices must be positive")
+
     if args.dry_run:
-        print(f"ladder walk ({len(rungs)} rungs, priority order):")
-        for i, (b, k, m) in enumerate(rungs):
-            print(f"  {i + 1}. B={b} K={k} M={m}")
+        if args.devices > 1:
+            # the mesh ladder (ISSUE 11): headline rungs first ACROSS
+            # devices — every chip gets the big warm rung before any
+            # chip gets the next one (same order CompileService.start
+            # enqueues with a mesh attached)
+            print(
+                f"mesh ladder walk ({len(rungs)} rungs x "
+                f"{args.devices} devices, priority order):"
+            )
+            i = 0
+            for b, k, m in rungs:
+                for dev in range(args.devices):
+                    i += 1
+                    print(f"  {i}. B={b} K={k} M={m} dev={dev}")
+        else:
+            print(f"ladder walk ({len(rungs)} rungs, priority order):")
+            for i, (b, k, m) in enumerate(rungs):
+                print(f"  {i + 1}. B={b} K={k} M={m}")
         # gathered variants (ISSUE 10): with a device key table attached
         # the service also warms the "gather" program per (B, K) —
         # capacity-keyed, sub-second, warmed in-node (never prebaked:
@@ -128,67 +156,93 @@ def main(argv=None) -> int:
     from lighthouse_tpu.compile_service import lowering
     from lighthouse_tpu.crypto.device import fp
 
+    mesh = None
+    if args.devices > 1:
+        # a real mesh: the warm_staged shard scope commits the dummy
+        # args (and so the compile) to each chip in turn
+        from lighthouse_tpu.crypto.device import mesh as mesh_mod
+
+        mesh = mesh_mod.DeviceMesh(n_devices=args.devices)
+        mesh_mod.set_mesh(mesh)
+
     impl = fp.get_impl()
     env_key = cache_mod.environment_key(impl)
     records = []
     t_total = time.perf_counter()
     for b, k, m in rungs:
-        prebaked = bool(
-            manifest is not None
-            and all(
-                manifest.has(cache_mod.manifest_key(env_key, s, b, k, m))
-                for s in lowering.STAGES
-            )
-        )
-        files_before = (
-            cache_mod.executable_entries(cache_dir)
-            if manifest is not None
-            else None
-        )
-        t0 = time.perf_counter()
-        stages = lowering.warm_staged(b, k, m)
-        seconds = time.perf_counter() - t0
-        if manifest is not None:
-            # manifest honesty (same probe as CompileService._compile_rung):
-            # a fresh compile that left no new executable behind must not
-            # claim the rung prebaked — unless it already was (a cache-
-            # served warm restart adds no files)
-            persisted = cache_mod.persisted_after(
-                cache_dir,
-                files_before,
-                any(r["fresh"] for r in stages.values()),
-            )
-            if persisted or prebaked:
-                manifest.add_many(
-                    [
-                        cache_mod.manifest_key(env_key, stage, b, k, m)
-                        for stage in lowering.STAGES
-                    ],
-                    source="warmup_cli",
+        for dev in range(args.devices):
+            prebaked = bool(
+                manifest is not None
+                and all(
+                    manifest.has(
+                        cache_mod.manifest_key(env_key, s, b, k, m, device=dev)
+                    )
+                    for s in lowering.STAGES
                 )
-            else:
-                print(
-                    f"cache stored no executable for B={b} K={k} M={m}; "
-                    f"manifest NOT updated",
-                    file=sys.stderr,
+            )
+            files_before = (
+                cache_mod.executable_entries(cache_dir)
+                if manifest is not None
+                else None
+            )
+            t0 = time.perf_counter()
+            stages = lowering.warm_staged(
+                b, k, m, shard=dev if mesh is not None else None
+            )
+            seconds = time.perf_counter() - t0
+            if manifest is not None:
+                # manifest honesty (same probe as
+                # CompileService._compile_rung): a fresh compile that
+                # left no new executable behind must not claim the rung
+                # prebaked — unless it already was (a cache-served warm
+                # restart adds no files)
+                persisted = cache_mod.persisted_after(
+                    cache_dir,
+                    files_before,
+                    any(r["fresh"] for r in stages.values()),
                 )
-        rec = {
-            "b": b, "k": k, "m": m, "fp_impl": impl,
-            "seconds": round(seconds, 2),
-            "manifest_prebaked": prebaked,
-            "stages": {
-                s: {"seconds": round(r["seconds"], 2), "fresh": r["fresh"]}
-                for s, r in stages.items()
-            },
-        }
-        records.append(rec)
-        print(
-            f"warmed B={b} K={k} M={m} [{impl}] in {seconds:7.2f}s"
-            f"{' (manifest: prebaked)' if prebaked else ''}",
-            flush=True,
-        )
+                if persisted or prebaked:
+                    manifest.add_many(
+                        [
+                            cache_mod.manifest_key(
+                                env_key, stage, b, k, m, device=dev
+                            )
+                            for stage in lowering.STAGES
+                        ],
+                        source="warmup_cli",
+                    )
+                else:
+                    print(
+                        f"cache stored no executable for B={b} K={k} "
+                        f"M={m} dev={dev}; manifest NOT updated",
+                        file=sys.stderr,
+                    )
+            rec = {
+                "b": b, "k": k, "m": m, "fp_impl": impl,
+                "seconds": round(seconds, 2),
+                "manifest_prebaked": prebaked,
+                "stages": {
+                    s: {"seconds": round(r["seconds"], 2), "fresh": r["fresh"]}
+                    for s, r in stages.items()
+                },
+            }
+            if args.devices > 1:
+                rec["device"] = dev
+            records.append(rec)
+            dev_tag = f" dev={dev}" if args.devices > 1 else ""
+            print(
+                f"warmed B={b} K={k} M={m}{dev_tag} [{impl}] in "
+                f"{seconds:7.2f}s"
+                f"{' (manifest: prebaked)' if prebaked else ''}",
+                flush=True,
+            )
+    if mesh is not None:
+        from lighthouse_tpu.crypto.device import mesh as mesh_mod
+
+        mesh_mod.clear_mesh(mesh)
     summary = {
         "fp_impl": impl,
+        "devices": args.devices,
         "total_s": round(time.perf_counter() - t_total, 2),
         "cache": cache_status,
         "rungs": records,
