@@ -402,6 +402,96 @@ def measure_planner_leg(sets, B, K, M, reps: int = 3):
     }
 
 
+def measure_pipeline_leg(sets, B, K, M, reps: int = 3, n_callers: int = 4):
+    """Pipeline-occupancy profile at the headline rung (ISSUE 12):
+    device bubble ratio with cause attribution, flush-thread saturation
+    and the overlap-potential projection — the sizing input for ROADMAP
+    item 5's double-buffered pack pipeline, measured through the REAL
+    scheduler at the already-warm headline shape (plan_flushes off +
+    headline pad = zero new XLA compiles; the steady-recompile delta
+    pins it). ``bubble_ratio`` feeds the bench_diff gate."""
+    import threading
+
+    import jax
+
+    from lighthouse_tpu.crypto.device.bls import (
+        pack_signature_sets_raw,
+        verify_batch_raw_staged,
+    )
+    from lighthouse_tpu.utils import metrics, pipeline_profiler
+    from lighthouse_tpu.verification_service import VerificationScheduler
+
+    if not pipeline_profiler.enabled():
+        return {"skipped": "pipeline profiler disabled"}
+
+    def device_verify(s):
+        args = pack_signature_sets_raw(s, pad_b=B, pad_k=K, pad_m=M)
+        return bool(jax.block_until_ready(verify_batch_raw_staged(*args)))
+
+    # -O-safe warm-up raise (the headline bucket already compiled this
+    # shape; a failure here is a workload bug, not a compile)
+    if device_verify(sets) is not True:
+        raise RuntimeError("pipeline leg warm-up batch must verify")
+
+    def _recompiles() -> float:
+        m = metrics.get("bls_device_recompiles_total")
+        return sum(c.value for c in m.children().values()) if m else 0.0
+
+    pipeline_profiler.reset()
+    rec0 = _recompiles()
+    chunk = (len(sets) + n_callers - 1) // n_callers
+    chunks = [sets[i: i + chunk] for i in range(0, len(sets), chunk)]
+    kinds = ("unaggregated", "aggregate", "sync_message", "sync_contribution")
+    sched = VerificationScheduler(
+        verify_fn=device_verify,
+        deadline_ms=2000.0,
+        max_batch_sets=len(sets),  # bucket-full fires on the last feeder
+        max_queue_sets=4 * len(sets),
+        plan_flushes=False,  # keep every flush on the one warm rung
+    ).start()
+    try:
+        for _ in range(reps):
+            futs = [None] * len(chunks)
+
+            def feed(i):
+                futs[i] = sched.submit(chunks[i], kinds[i % len(kinds)])
+
+            threads = [
+                threading.Thread(target=feed, args=(i,))
+                for i in range(len(chunks))
+            ]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            if not all(f.result(timeout=1800) for f in futs):
+                raise RuntimeError("pipeline leg flushes must verify")
+    finally:
+        sched.stop()
+    doc = pipeline_profiler.summary()
+    shard0 = doc["shards"].get("0", {})
+    ov = doc["overlap_potential"]
+    return {
+        "B": B, "K": K, "M": M, "n_sets": len(sets), "reps": reps,
+        "flushes": doc["flushes"]["count"],
+        "steady_recompiles": _recompiles() - rec0,
+        "bubble_ratio": shard0.get("bubble_ratio"),
+        "dominant_bubble_cause": shard0.get("dominant_cause"),
+        "bubble_causes_s": shard0.get("causes"),
+        "flush_thread_saturation": doc["flush_thread_saturation"],
+        "flush_phases_s": {
+            p: doc["flushes"][f"{p}_s"]
+            for p in pipeline_profiler.FLUSH_PHASES
+        },
+        "flush_wall_s": doc["flushes"]["wall_s"],
+        "overlap": {
+            "measured_sets_per_sec": ov["measured_sets_per_sec"],
+            "projected_sets_per_sec": ov["projected_sets_per_sec"],
+            "projected_speedup": ov["projected_speedup"],
+        },
+    }
+
+
 def measure_key_table_leg(sets, B, K, M, reps: int = 3):
     """Device-resident pubkey table on/off at the headline bucket
     (ISSUE 10), same repeat-validator traffic both legs: the OFF leg
@@ -1087,6 +1177,18 @@ def main() -> None:
         except Exception as e:  # the leg must not kill the line
             planner_leg = {"error": str(e)[:200]}
 
+    # Pipeline-occupancy profile at the headline rung (ISSUE 12):
+    # bubble ratio + cause split, flush-thread saturation, and the
+    # overlap-potential projection (ROADMAP item 5's go/no-go number).
+    # Cheap: the headline rung is already warm, zero new compiles.
+    if _budget_left() < 240:
+        pipeline_leg = {"skipped": "budget"}
+    else:
+        try:
+            pipeline_leg = measure_pipeline_leg(sets, B_PAD, K_PAD, M_PAD)
+        except Exception as e:  # the leg must not kill the line
+            pipeline_leg = {"error": str(e)[:200]}
+
     # Device key table on/off at the headline bucket (ISSUE 10): the
     # pubkey-plane bytes/set drop and pack-time delta under the same
     # repeat-validator traffic. The staged rung is already warm; the ON
@@ -1205,6 +1307,7 @@ def main() -> None:
                 "data_movement": data_movement,
                 "scheduler_leg": scheduler_leg,
                 "planner_leg": planner_leg,
+                "pipeline_leg": pipeline_leg,
                 "key_table_leg": key_table_leg,
                 "replay_leg": replay_leg,
                 "dp_leg": dp_leg,

@@ -46,7 +46,13 @@ import time
 from collections import deque
 from typing import Callable, Iterable, Optional, Sequence, Tuple
 
-from ..utils import flight_recorder, metrics, tracing, transfer_ledger
+from ..utils import (
+    flight_recorder,
+    metrics,
+    pipeline_profiler,
+    tracing,
+    transfer_ledger,
+)
 from ..verification_service import planner as _planner
 from ..verification_service import round_up_bucket
 from . import cache as _cache
@@ -547,6 +553,7 @@ class CompileService:
         backend's infinity pre-screens, and exceptions PROPAGATE like the
         direct call's would (the scheduler's bisection delivers them to
         exactly the leaf submission that caused them)."""
+        t0 = time.perf_counter()
         try:
             with tracing.span(
                 "compile_service.fallback_verify", n_sets=len(sets)
@@ -584,6 +591,12 @@ class CompileService:
             # finally so a raising verify still journals exactly one
             # row, mirroring the device path's raise behavior
             transfer_ledger.record_cpu(len(sets))
+            # pipeline profiler (ISSUE 12): a shed flush resolving on
+            # the CPU is exactly the window the device idles for a
+            # compile-caused reason — the wall lands as `compile`
+            # activity (bubble attribution) and as the current flush
+            # record's `fallback` phase
+            pipeline_profiler.note_fallback_wall(t0, time.perf_counter())
 
     def _fallback_backend_inst(self):
         if self._fallback_backend is None:

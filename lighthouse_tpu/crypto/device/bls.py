@@ -40,7 +40,13 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ...utils import flight_recorder, metrics, tracing, transfer_ledger
+from ...utils import (
+    flight_recorder,
+    metrics,
+    pipeline_profiler,
+    tracing,
+    transfer_ledger,
+)
 from ..params import DST, G1_X, G1_Y, P, R, X
 from ..cpu.pairing import PSI_CX, PSI_CY
 from ..cpu.hash_to_curve import hash_to_g2
@@ -503,13 +509,16 @@ def _run_stage(stage: str, fn, *args):
     from . import mesh as _mesh_mod
 
     impl = fp.get_impl()
+    shard = _mesh_mod.current_shard() or 0
     key = (
         stage,
         impl,
-        _mesh_mod.current_shard() or 0,
+        shard,
         tuple((tuple(a.shape), str(a.dtype)) for a in args),
     )
-    with tracing.span(f"bls.{stage}", fp_impl=impl):
+    # shard attr: tools/trace_report.py groups device-stage spans into
+    # per-shard chrome lanes (ISSUE 12)
+    with tracing.span(f"bls.{stage}", fp_impl=impl, shard=shard):
         t0 = time.perf_counter()
         out = jax.block_until_ready(fn(*args))
         elapsed = time.perf_counter() - t0
@@ -522,6 +531,14 @@ def _run_stage(stage: str, fn, *args):
             _seen_stage_shapes.add(key)
     if fresh:
         _RECOMPILES.with_labels(stage).inc()
+    # pipeline profiler (ISSUE 12): this dispatch-to-sync wall is a
+    # device BUSY interval on its shard; the gap since the shard's
+    # previous sync is a bubble, attributed to pack/plan/compile/
+    # queue_empty/other. A fresh dispatch's wall includes the XLA
+    # compile, so it is also recorded as compile activity.
+    pipeline_profiler.note_stage_wall(
+        stage, shard, t0, t0 + elapsed, fresh=fresh
+    )
     return out, elapsed, fresh
 
 
@@ -586,6 +603,13 @@ def stage_latency_summary(impl: str | None = None) -> dict:
         if row:
             row.pop("fp_impl", None)
             out[f"pack:{phase}"] = row
+    # device idle-gap attribution (pipeline profiler, ISSUE 12): the
+    # bubble rows ride along keyed bubble:<cause> so bench/trace
+    # readers see where device idle went next to the stage and pack
+    # splits (sum_s/count/mean_s — counters, not histograms: no
+    # quantiles to report)
+    for cause, row in pipeline_profiler.bubble_rows().items():
+        out[f"bubble:{cause}"] = row
     return out
 
 
@@ -957,6 +981,9 @@ def pack_signature_sets_raw(
         },
         pubkey_blobs=pk_blobs,
     )
+    # pipeline profiler (ISSUE 12): the whole pack is host activity —
+    # a device gap overlapping it attributes to cause `pack`
+    pipeline_profiler.note_pack_wall(t_start, t_start + total_s)
     return args
 
 
@@ -1060,6 +1087,9 @@ def pack_signature_sets_indexed(
         pubkey_blobs=(),  # nothing G1-shaped crossed the boundary
         indexed=True,
     )
+    # pipeline profiler (ISSUE 12): same pack-activity contract as the
+    # raw packer — the static half's wall is host time too
+    pipeline_profiler.note_pack_wall(t_start, t_start + total_s)
     return args
 
 

@@ -320,6 +320,12 @@ class DeviceMesh:
         is currently delivering."""
         now = time.monotonic()
         mem = self.memory_by_shard()
+        # per-chip bubble ratio (pipeline profiler, ISSUE 12): the
+        # idle/(busy+idle) share of this chip's staged dispatch timeline
+        # — None before its first dispatch. Lazy import keeps the mesh's
+        # import surface minimal (both modules are jax-free).
+        from ...utils import pipeline_profiler
+
         with self._lock:
             chips = []
             agg_rate = 0.0
@@ -339,6 +345,7 @@ class DeviceMesh:
                     "dispatches": st.dispatches,
                     "sets_per_sec": round(rate, 2),
                     "device_memory_bytes": mem.get(i),
+                    "bubble_ratio": pipeline_profiler.shard_bubble_ratio(i),
                     "lost_error": st.lost_error,
                 })
             healthy = [i for i, s in self._shards.items() if s.healthy]

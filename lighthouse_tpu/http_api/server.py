@@ -446,6 +446,14 @@ class BeaconApiServer:
             # dp axis delivers (null when the node runs single-device)
             dmesh = getattr(chain, "device_mesh", None)
             doc["mesh"] = None if dmesh is None else dmesh.status()
+            # pipeline-occupancy profiler (ISSUE 12): per-shard device
+            # bubble ratios with cause attribution, flush critical-path
+            # phase totals, flush-thread saturation and the overlap-
+            # potential projection — the evidence base for ROADMAP
+            # item 5; rendered by tools/pipeline_report.py
+            from ..utils import pipeline_profiler
+
+            doc["pipeline"] = pipeline_profiler.summary()
             return {"data": doc}
         if path == "/lighthouse/flight_recorder":
             # live journal tail: ?kind=a,b filters, ?limit=N bounds the

@@ -68,6 +68,7 @@ EVENT_KINDS = (
     "log",                    # utils/logging.py, warn/error/crit lines
     "peer_ban",               # network/peer_manager.py
     "peer_penalty",           # network/peer_manager.py
+    "pipeline_flush",         # utils/pipeline_profiler.py, one per flush
     "queue_shed",             # beacon_processor/processor.py
     "scheduler_bisection",    # verification_service/batcher.py, per split
     "scheduler_flush",        # verification_service/batcher.py, per batch

@@ -105,7 +105,13 @@ from concurrent.futures import Future
 from typing import Callable, List, Optional, Sequence
 
 from ..crypto import bls
-from ..utils import flight_recorder, metrics, tracing, transfer_ledger
+from ..utils import (
+    flight_recorder,
+    metrics,
+    pipeline_profiler,
+    tracing,
+    transfer_ledger,
+)
 from .slo import SloTracker
 
 # Mirrors crypto/device/bls._round_up's choices without importing the
@@ -568,9 +574,25 @@ class VerificationScheduler:
                     if deadline is not None and now >= deadline:
                         trigger = "deadline"
                         break
+                    # pipeline profiler (ISSUE 12): an empty-queue wait
+                    # is the `queue_empty` bubble cause — a device gap
+                    # overlapping it is traffic's fault, not the
+                    # pipeline's (timed only when the queue is empty;
+                    # a deadline-armed wait has work pending). Opened
+                    # EAGERLY: a verify_now gap closing while this
+                    # thread is still parked must see the wait.
+                    idle_t0 = (
+                        time.perf_counter() if not self._pending else None
+                    )
+                    if idle_t0 is not None:
+                        pipeline_profiler.note_idle_begin(idle_t0)
                     self._cv.wait(
                         None if deadline is None else deadline - now
                     )
+                    if idle_t0 is not None:
+                        pipeline_profiler.note_idle_end(
+                            idle_t0, time.perf_counter()
+                        )
                 subs = self._drain_locked()
                 self._flush_requested = False
                 stopped = self._stopped
@@ -602,6 +624,15 @@ class VerificationScheduler:
         for s in subs:
             _QUEUE_WAIT.observe(now - s.submitted_at)
             _SETS_TOTAL.with_labels(s.kind).inc(len(s.sets))
+        # pipeline profiler (ISSUE 12): one lifecycle record per flush —
+        # queue-wait (the oldest submission's), plan, pack, device and
+        # fallback walls accumulate from this thread and the dp workers
+        # (flush_scope below), and flush_end journals ONE pipeline_flush
+        # event with the critical-path split (None when disabled)
+        prec = pipeline_profiler.flush_begin(
+            trigger=trigger, kinds=kinds_mix, n_submissions=len(subs),
+            n_sets=n_sets, queue_wait_s=now - subs[0].submitted_at,
+        )
         svc = self._compile_service
         if svc is not None and not svc.active():
             svc = None
@@ -628,7 +659,11 @@ class VerificationScheduler:
                     warm = svc.warm_rungs_active()
             except Exception:
                 warm = None
+        t_plan = time.perf_counter()
         plan = self._planner.plan(subs, warm_rungs=warm, shards=shards)
+        pipeline_profiler.note_plan_wall(
+            t_plan, time.perf_counter(), record=prec
+        )
         _PLANS.with_labels(plan.mode).inc()
         _FLUSHES.with_labels(trigger).inc()
         waste = plan.waste()
@@ -666,20 +701,25 @@ class VerificationScheduler:
             dp_shards=len(plan.shards_used()),
         ) as sp:
             def run_one(idx: int, sb) -> None:
-                try:
-                    results[idx] = self._dispatch_sub_batch(
-                        sb, svc, mesh, plan.mode, trigger
-                    )
-                except BaseException as e:  # noqa: BLE001 — futures first
-                    # a worker must NEVER strand its futures: whatever
-                    # slipped past the dispatch path's own handling is
-                    # delivered to every submission (the caller sees the
-                    # raise a direct call would have surfaced)
-                    for s in sb.subs:
-                        self._account(s, "sub_batch")
-                        _SUBMISSIONS.with_labels(s.kind, "error").inc()
-                        if not s.future.done():
-                            s.future.set_exception(e)
+                # the profiler scope rides on the dispatching thread
+                # (flush thread for serial plans, a per-sub-batch worker
+                # for dp plans): pack/device/fallback walls fired under
+                # it attribute to THIS flush's lifecycle record
+                with pipeline_profiler.flush_scope(prec):
+                    try:
+                        results[idx] = self._dispatch_sub_batch(
+                            sb, svc, mesh, plan.mode, trigger
+                        )
+                    except BaseException as e:  # noqa: BLE001 — futures first
+                        # a worker must NEVER strand its futures: whatever
+                        # slipped past the dispatch path's own handling is
+                        # delivered to every submission (the caller sees the
+                        # raise a direct call would have surfaced)
+                        for s in sb.subs:
+                            self._account(s, "sub_batch")
+                            _SUBMISSIONS.with_labels(s.kind, "error").inc()
+                            if not s.future.done():
+                                s.future.set_exception(e)
 
             if multi_shard:
                 workers = [
@@ -709,6 +749,14 @@ class VerificationScheduler:
                     dev_padded += rec["paid"]
                 all_ok = all_ok and rec["ok"]
             sp.set(verdict=all_ok)
+        # one pipeline_flush journal row per flush — bisections, shed
+        # sub-batches and worker crashes included (the record closed is
+        # the record opened; exactly-once pinned by test)
+        pipeline_profiler.flush_end(
+            prec, verdict=all_ok, mode=plan.mode,
+            n_sub_batches=len(plan.sub_batches),
+            dp_shards=plan.shards_used(),
+        )
         if dev_padded:
             # gauges describe device lanes only (consistent with
             # verification_scheduler_plan_lanes_total): an all-shed

@@ -17,7 +17,8 @@ import tools.bench_diff as bench_diff
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _bench_doc(sets_per_sec, waste, wrapped=False, kt_bytes=45.0):
+def _bench_doc(sets_per_sec, waste, wrapped=False, kt_bytes=45.0,
+               bubble=0.2):
     doc = {
         "metric": "bls_sigset_verifications_per_sec_per_chip",
         "value": sets_per_sec,
@@ -44,6 +45,13 @@ def _bench_doc(sets_per_sec, waste, wrapped=False, kt_bytes=45.0):
             "dp1": {"sets_per_sec": sets_per_sec},
             "dp2": {"sets_per_sec": sets_per_sec * 0.9},
             "aggregate_speedup": 0.9,
+        },
+        # ISSUE 12: the pipeline leg's headline-rung bubble ratio is
+        # gated (a growing bubble = the device starving behind the host)
+        "pipeline_leg": {
+            "bubble_ratio": bubble,
+            "flush_thread_saturation": 0.3,
+            "overlap": {"projected_speedup": 1.2},
         },
     }
     return {"n": 1, "rc": 0, "parsed": doc} if wrapped else doc
@@ -100,6 +108,16 @@ def test_diff_exits_nonzero_on_regression(tmp_path):
         bench_diff.load_bench(old), bench_diff.load_bench(kt_bad)
     )
     assert rep_kt["regressions"] == ["key_table_pubkeys_bytes_per_set"]
+    # ISSUE 12 gate: the pipeline leg's bubble ratio growing >20%
+    # (the device starving behind the host) exits nonzero too
+    pb_bad = _write(
+        tmp_path, "f_pb.json", _bench_doc(10.0, 0.5, bubble=0.6)
+    )
+    assert bench_diff.main([old, pb_bad]) == 1
+    rep_pb = bench_diff.diff(
+        bench_diff.load_bench(old), bench_diff.load_bench(pb_bad)
+    )
+    assert rep_pb["regressions"] == ["pipeline_bubble_ratio"]
     # a gate that cannot be evaluated is reported LOUDLY, not silently
     # dropped (exit stays 0 — absence of data is not a regression)
     legacy = dict(_bench_doc(10.0, 0.5))

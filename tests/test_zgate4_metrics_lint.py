@@ -333,6 +333,43 @@ def test_dp_mesh_families_registered():
     assert r.returncode == 0, r.stderr
 
 
+def test_pipeline_profiler_families_registered():
+    """ISSUE 12 families (utils/pipeline_profiler.py) exist under their
+    declared types + labels, the phase/cause catalogues stay pinned
+    (the bubble attribution priority and the flush timeline are API
+    surfaces the docs and tools read), and the report tool imports
+    cleanly (jax-freedom is subprocess-pinned in
+    tests/test_pipeline_profiler.py)."""
+    _import_instrumented_modules()
+    reg = metrics.registry_snapshot()
+    want = {
+        "bls_device_bubble_seconds_total": ("counter", ("shard", "cause")),
+        "bls_device_shard_busy_seconds_total": ("counter", ("shard",)),
+        "verification_scheduler_flush_phase_seconds_total": (
+            "counter", ("phase",),
+        ),
+        "verification_scheduler_flush_thread_saturation": ("gauge", None),
+        "verification_scheduler_overlap_potential_ratio": ("gauge", None),
+    }
+    for name, (kind, labels) in want.items():
+        m = reg.get(name)
+        assert m is not None, f"family {name} not registered"
+        assert m.kind == kind, (name, m.kind)
+        if labels is not None:
+            assert m.labelnames == labels, (name, m.labelnames)
+        else:
+            assert not hasattr(m, "labelnames"), name  # unlabeled family
+    from lighthouse_tpu.utils import pipeline_profiler
+
+    assert pipeline_profiler.BUBBLE_CAUSES == (
+        "pack", "plan", "compile", "queue_empty", "other",
+    )
+    assert pipeline_profiler.FLUSH_PHASES == (
+        "queue_wait", "plan", "pack", "device", "fallback", "resolve",
+    )
+    import tools.pipeline_report  # noqa: F401
+
+
 def test_warmup_tool_imports_and_dry_run_lists_ladder(capsys, monkeypatch):
     """ISSUE 5 CI satellite: ``tools/warmup.py`` must import cleanly and
     ``--dry-run`` must list the ladder walk WITHOUT compiling anything

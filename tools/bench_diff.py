@@ -69,15 +69,26 @@ METRICS = (
     ("dp1_sets_per_sec", ("dp_leg", "dp1", "sets_per_sec"), True),
     ("dp2_sets_per_sec", ("dp_leg", "dp2", "sets_per_sec"), True),
     ("dp_aggregate_speedup", ("dp_leg", "aggregate_speedup"), True),
+    # ISSUE 12: the pipeline-occupancy leg — the headline-rung bubble
+    # ratio is gated (a growing bubble means the device started
+    # starving behind the host); saturation and the overlap projection
+    # ride along ungated (sizing inputs for ROADMAP item 5, not SLOs)
+    ("pipeline_bubble_ratio", ("pipeline_leg", "bubble_ratio"), False),
+    ("pipeline_flush_saturation",
+     ("pipeline_leg", "flush_thread_saturation"), None),
+    ("pipeline_overlap_speedup",
+     ("pipeline_leg", "overlap", "projected_speedup"), True),
 )
 
 # the metrics whose regression exits nonzero (ISSUE 8 throughput/waste
-# gates + the ISSUE 10 key-table bytes gate + the ISSUE 11 dp gate)
+# gates + the ISSUE 10 key-table bytes gate + the ISSUE 11 dp gate +
+# the ISSUE 12 pipeline-bubble gate)
 GATED = (
     "headline_sets_per_sec",
     "headline_padding_waste",
     "key_table_pubkeys_bytes_per_set",
     "dp2_sets_per_sec",
+    "pipeline_bubble_ratio",
 )
 
 

@@ -360,6 +360,20 @@ def main() -> None:
       "`bls_device_shard_*` families and the `/lighthouse/health` "
       "`mesh` block in [OBSERVABILITY.md](OBSERVABILITY.md); 1-vs-2 "
       "device measurements in the bench `dp_leg`).")
+    w("- Overlap potential (ISSUE 12): every per-batch cost above is "
+      "charged as if pack and device compute were SERIAL — the flush "
+      "thread packs, dispatches and blocks until sync, so the device "
+      "idles for the whole host pack. The pipeline profiler measures "
+      "that idle directly and attributes it per cause "
+      "(`bls_device_bubble_seconds_total{shard,cause}`, flush "
+      "critical-path `pipeline_flush` events) and projects the ROADMAP "
+      "item 5 win: overlapping pack for flush N+1 with flush N's "
+      "device time hides min(pack, device) per flush — the "
+      "`overlap_potential` block in `/lighthouse/health` `pipeline` "
+      "and the bench `pipeline_leg` carry the projected sets/s "
+      "(`verification_scheduler_overlap_potential_ratio`; modeled "
+      "offline by `tools/pipeline_report.py`; families in "
+      "[OBSERVABILITY.md](OBSERVABILITY.md) pipeline section).")
     w("- Setup cost, not in these tables: the FIRST dispatch of each "
       "staged program at a fresh bucket shape pays the XLA compile "
       "(~120 s for the B=64 headline rung on this host, BENCH_r05 / the "

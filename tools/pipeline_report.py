@@ -1,0 +1,361 @@
+"""Render pipeline-occupancy (device bubble) attribution (ISSUE 12) —
+from a live node's `/lighthouse/health` or, jax-free, from an
+arrival-trace lockstep model.
+
+ROADMAP item 5 (double-buffered pack pipeline: overlap host pack with
+device compute) needs a sized win before it is built: how much device
+time is spent idle, and how much of that idle is the host pack the
+refactor would hide. This tool renders that evidence base — the same
+live/model split as ``tools/transfer_report.py``:
+
+    # live node (or a saved health document): MEASURED bubble ratios,
+    # cause attribution, flush phase split, overlap projection
+    python tools/pipeline_report.py --url http://127.0.0.1:5052
+    python tools/pipeline_report.py --health-json /tmp/health.json
+
+    # jax-free model: lockstep-replay a trace's exact flush plans and
+    # price each flush's pack/device structure with explicit per-set /
+    # per-lane cost constants (stated in the report — a modeled number
+    # can never masquerade as a measured one)
+    python tools/pipeline_report.py --generate gossip_steady \\
+        --duration 24 --seed 7
+    python tools/pipeline_report.py --trace /tmp/flood.jsonl --dp 2 --json
+
+Live mode reads the pipeline profiler's measured state
+(``utils/pipeline_profiler.summary()`` as served in the health
+``pipeline`` block); model mode derives PREDICTED numbers from the
+scheduler's exact flush policy (``lockstep_replay``) and two explicit
+cost constants: host pack priced per live set (``--pack-ms-per-set``)
+and device time per padded lane (``--device-us-per-lane``) — the same
+B*K*M lane unit the cost model and the flush planner score with
+(docs/COST_MODEL.md). Modeled bubble causes are ``pack`` (every shard
+idles while the host packs serially — exactly the window ROADMAP
+item 5 overlaps away) and ``imbalance`` (a dp shard finishing before
+the flush's busiest shard); inter-flush queue gaps are timing-dependent
+and deliberately NOT modeled (the live ``queue_empty`` cause covers
+them), stated in the report's assumption string.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPORT_SCHEMA = "lighthouse_tpu.pipeline_report/1"
+
+
+# ---------------------------------------------------------------------------
+# Model mode (jax-free)
+# ---------------------------------------------------------------------------
+
+
+def bubble_model(
+    events,
+    deadline_ms: float = 25.0,
+    max_batch_sets: int = 256,
+    pack_ms_per_set: float = 0.4,
+    device_us_per_lane: float = 40.0,
+    shards=None,
+) -> dict:
+    """Price a trace's pipeline structure without a device: lockstep-
+    replay the flush policy, then per flush charge host pack =
+    ``n_sets * pack_ms_per_set`` (serial — every shard idles under it)
+    and per-shard device busy = ``padded lanes * device_us_per_lane``
+    (shards run concurrently; a shard lighter than the busiest idles
+    the difference, cause ``imbalance``). The overlap-potential
+    projection hides the smaller of (pack, busiest-shard device) per
+    flush — the same formula the live profiler serves."""
+    from lighthouse_tpu.verification_service import traffic
+
+    report = traffic.lockstep_replay(
+        events, deadline_ms=deadline_ms, max_batch_sets=max_batch_sets,
+        shards=shards,
+    )
+    pack_s_per_set = pack_ms_per_set / 1000.0
+    lane_s = device_us_per_lane / 1e6
+
+    per_shard: dict = {}
+
+    def shard_rec(s):
+        return per_shard.setdefault(
+            str(s),
+            {"busy_s": 0.0, "idle_s": 0.0,
+             "causes": {"pack": 0.0, "imbalance": 0.0}},
+        )
+
+    # every modeled mesh shard exists from the start: a chip the plan
+    # never uses still idles through every flush window — omitting it
+    # would read a trickle-starved 2-chip mesh as fully balanced
+    for s in (shards or ()):
+        shard_rec(s)
+
+    n_sets_total = 0
+    measured_wall = projected_wall = 0.0
+    pack_total = device_total = 0.0
+    for fl in report["flushes"]:
+        n = fl["n_sets"]
+        n_sets_total += n
+        pack_s = n * pack_s_per_set
+        busy = {}
+        for sb in fl["sub_batches"]:
+            rb, rk, rm = sb["rung"]
+            s = sb["shard"] if sb["shard"] is not None else 0
+            busy[s] = busy.get(s, 0.0) + rb * rk * rm * lane_s
+        window = max(busy.values()) if busy else 0.0
+        # every shard seen so far idles under this flush too: one the
+        # plan skipped (dp_min_sets floor, kind split) spends the whole
+        # device window waiting — that IS an imbalance bubble
+        flush_shards = (
+            set(busy) | {int(k) for k in per_shard} if busy else set()
+        )
+        for s in sorted(flush_shards):
+            rec = shard_rec(s)
+            b = busy.get(s, 0.0)
+            rec["busy_s"] += b
+            # serial pack: the whole mesh idles under it
+            rec["idle_s"] += pack_s + (window - b)
+            rec["causes"]["pack"] += pack_s
+            rec["causes"]["imbalance"] += window - b
+        device_sum = sum(busy.values())
+        pack_total += pack_s
+        device_total += device_sum
+        measured_wall += pack_s + window
+        projected_wall += max(pack_s, window)
+
+    for rec in per_shard.values():
+        span = rec["busy_s"] + rec["idle_s"]
+        rec["bubble_ratio"] = round(rec["idle_s"] / span, 4) if span else 0.0
+        rec["busy_s"] = round(rec["busy_s"], 6)
+        rec["idle_s"] = round(rec["idle_s"], 6)
+        rec["causes"] = {
+            c: round(v, 6) for c, v in rec["causes"].items() if v > 0
+        }
+        rec["dominant_cause"] = (
+            max(rec["causes"].items(), key=lambda kv: kv[1])[0]
+            if rec["causes"] else None
+        )
+
+    return {
+        "schema": REPORT_SCHEMA,
+        "mode": "bubble_model",
+        "assumption": (
+            "host pack priced per live set, device per padded B*K*M "
+            "lane (the planner/cost-model lane unit); pack is serial "
+            "(every shard idles under it), shards run concurrently "
+            "(lighter shards idle to the busiest, cause=imbalance); "
+            "inter-flush queue gaps are NOT modeled — the live "
+            "queue_empty cause covers them. MODELED, not measured — "
+            "the measured counterpart is the health `pipeline` block "
+            "and bls_device_bubble_seconds_total{shard,cause}"
+        ),
+        "pack_ms_per_set": pack_ms_per_set,
+        "device_us_per_lane": device_us_per_lane,
+        "n_events": len(events),
+        "n_flushes": len(report["flushes"]),
+        "n_sets": n_sets_total,
+        "per_shard": dict(sorted(per_shard.items())),
+        "flush_totals": {
+            "pack_s": round(pack_total, 6),
+            "device_s": round(device_total, 6),
+            "measured_wall_s": round(measured_wall, 6),
+        },
+        "flush_thread_saturation": (
+            round(pack_total / (pack_total + device_total), 4)
+            if pack_total + device_total else None
+        ),
+        "overlap_potential": {
+            "projected_wall_s": round(projected_wall, 6),
+            "measured_sets_per_sec": (
+                round(n_sets_total / measured_wall, 2)
+                if measured_wall else None
+            ),
+            "projected_sets_per_sec": (
+                round(n_sets_total / projected_wall, 2)
+                if projected_wall else None
+            ),
+            "projected_speedup": (
+                round(measured_wall / projected_wall, 4)
+                if projected_wall else None
+            ),
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# Live mode
+# ---------------------------------------------------------------------------
+
+
+def fetch_health(url: str) -> dict:
+    from urllib.request import urlopen
+
+    with urlopen(url.rstrip("/") + "/lighthouse/health", timeout=10) as r:
+        return json.loads(r.read().decode())
+
+
+def live_report(doc: dict) -> dict:
+    """Normalize a /lighthouse/health document (or its ``data`` body)
+    into this tool's report shape."""
+    body = doc.get("data", doc)
+    pipe = body.get("pipeline")
+    if pipe is None:
+        raise SystemExit(
+            "health document has no pipeline block (node predates the "
+            "pipeline profiler, or the block was stripped)"
+        )
+    return {"schema": REPORT_SCHEMA, "mode": "live", **pipe}
+
+
+# ---------------------------------------------------------------------------
+# Rendering
+# ---------------------------------------------------------------------------
+
+
+def _render_shards(w, shards: dict) -> None:
+    w(f"  {'shard':<7}{'busy_s':>10}{'idle_s':>10}{'bubble':>8}  causes")
+    for s, rec in sorted(shards.items(), key=lambda kv: int(kv[0])):
+        causes = " ".join(
+            f"{c}:{v:.3f}s" for c, v in sorted(
+                rec.get("causes", {}).items(),
+                key=lambda kv: -kv[1],
+            )
+        )
+        ratio = rec.get("bubble_ratio")
+        w(f"  {s:<7}{rec['busy_s']:>10.3f}{rec['idle_s']:>10.3f}"
+          f"{'n/a' if ratio is None else f'{ratio * 100:.1f}%':>8}"
+          f"  {causes}")
+
+
+def render(rep: dict) -> str:
+    lines = []
+    w = lines.append
+    if rep["mode"] == "live":
+        w("pipeline occupancy (measured, live profiler)")
+        fl = rep.get("flushes", {})
+        w(f"  flushes={fl.get('count', 0)} sets={fl.get('sets', 0)} "
+          f"wall={fl.get('wall_s', 0.0)}s")
+        w("  flush phases: " + "  ".join(
+            f"{p}={fl.get(f'{p}_s', 0.0):.3f}s"
+            for p in ("queue_wait", "plan", "pack", "device",
+                      "fallback", "resolve")
+        ))
+        sat = rep.get("flush_thread_saturation")
+        w(f"  flush-thread saturation (pack share of active wall): "
+          f"{'n/a' if sat is None else f'{sat * 100:.1f}%'}")
+        if rep.get("shards"):
+            _render_shards(w, rep["shards"])
+        else:
+            w("  (no shard has dispatched yet)")
+        ov = rep.get("overlap_potential", {})
+        w(f"  overlap potential (ROADMAP item 5): "
+          f"{ov.get('measured_sets_per_sec')} -> "
+          f"{ov.get('projected_sets_per_sec')} sets/s projected "
+          f"(x{ov.get('projected_speedup')}) — {ov.get('basis', '')}")
+        return "\n".join(lines)
+
+    w(f"pipeline occupancy (bubble model, {rep['n_events']} events, "
+      f"{rep['n_flushes']} flushes, {rep['n_sets']} sets)")
+    w(f"  constants: pack {rep['pack_ms_per_set']} ms/set, device "
+      f"{rep['device_us_per_lane']} us/lane")
+    _render_shards(w, rep["per_shard"])
+    ft = rep["flush_totals"]
+    w(f"  flush totals: pack={ft['pack_s']:.3f}s "
+      f"device={ft['device_s']:.3f}s wall={ft['measured_wall_s']:.3f}s "
+      f"(saturation {rep['flush_thread_saturation']})")
+    ov = rep["overlap_potential"]
+    w(f"  overlap potential: {ov['measured_sets_per_sec']} -> "
+      f"{ov['projected_sets_per_sec']} sets/s projected "
+      f"(x{ov['projected_speedup']})")
+    w(f"  assumption: {rep['assumption']}")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    src = ap.add_argument_group("source (exactly one)")
+    src.add_argument("--url", default=None,
+                     help="live node base URL (reads /lighthouse/health)")
+    src.add_argument("--health-json", default=None,
+                     help="saved /lighthouse/health JSON document")
+    src.add_argument("--trace", default=None,
+                     help="arrival-trace JSONL file (bubble model)")
+    src.add_argument("--generate", default=None,
+                     help="synthetic generator name (bubble model)")
+    gen = ap.add_argument_group("bubble model")
+    gen.add_argument("--seed", type=int, default=0)
+    gen.add_argument("--duration", type=float, default=None)
+    gen.add_argument("--rate-scale", type=float, default=1.0)
+    gen.add_argument("--deadline-ms", type=float, default=25.0)
+    gen.add_argument("--max-batch", type=int, default=256)
+    gen.add_argument("--pack-ms-per-set", type=float, default=0.4,
+                     help="modeled host pack cost per live set")
+    gen.add_argument("--device-us-per-lane", type=float, default=40.0,
+                     help="modeled device cost per padded B*K*M lane")
+    gen.add_argument("--dp", type=int, default=1,
+                     help="model a dp mesh of this width (shard axis)")
+    out = ap.add_argument_group("output")
+    out.add_argument("--json", action="store_true")
+    out.add_argument("--out", default=None)
+    args = ap.parse_args(argv)
+
+    chosen = [
+        s for s in (args.url, args.health_json, args.trace, args.generate)
+        if s is not None
+    ]
+    if len(chosen) != 1:
+        raise SystemExit(
+            "exactly one of --url / --health-json / --trace / --generate "
+            "is required"
+        )
+
+    if args.url:
+        rep = live_report(fetch_health(args.url))
+    elif args.health_json:
+        with open(args.health_json) as f:
+            rep = live_report(json.load(f))
+    else:
+        from lighthouse_tpu.verification_service import traffic
+
+        if args.trace:
+            _header, events = traffic.read_trace(args.trace)
+        else:
+            gen_fn = traffic.GENERATORS.get(args.generate)
+            if gen_fn is None:
+                raise SystemExit(
+                    f"unknown generator {args.generate!r}; have "
+                    f"{sorted(traffic.GENERATORS)}"
+                )
+            kw = {"seed": args.seed, "rate_scale": args.rate_scale}
+            if args.duration is not None:
+                kw["duration_s"] = args.duration
+            events = gen_fn(**kw)
+        rep = bubble_model(
+            events,
+            deadline_ms=args.deadline_ms,
+            max_batch_sets=args.max_batch,
+            pack_ms_per_set=args.pack_ms_per_set,
+            device_us_per_lane=args.device_us_per_lane,
+            shards=list(range(args.dp)) if args.dp > 1 else None,
+        )
+
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(rep, f, indent=1)
+    if args.json:
+        print(json.dumps(rep))
+    else:
+        print(render(rep))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

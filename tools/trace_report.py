@@ -28,6 +28,13 @@ report instead of stage quantiles:
 
     python tools/trace_report.py --replay /tmp/flood.jsonl \\
         --time-scale 0.5 -o /tmp/replay_trace.json
+
+Both modes add PER-SHARD DEVICE LANES (ISSUE 12): device-stage spans
+(or, for stub replays, ``scheduler.sub_batch`` spans) are mirrored onto
+one synthetic timeline lane per dp shard, with the idle gaps between
+them drawn as explicit ``bubble:<cause>`` slices — the chrome view of
+the pipeline profiler's ``bls_device_bubble_seconds_total`` counters
+(docs/OBSERVABILITY.md, pipeline section).
 """
 
 from __future__ import annotations
@@ -38,6 +45,116 @@ import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# ---------------------------------------------------------------------------
+# Per-shard device lanes (ISSUE 12): before the pipeline profiler, every
+# flush/sub_batch/stage span rendered on its host THREAD's timeline — a
+# 2-chip replay read as one interleaved lane and a device idle gap was
+# invisible. These helpers group the device-side spans by their `shard`
+# attribute onto synthetic per-shard lanes and draw the gaps between
+# consecutive device spans as explicit `bubble:<cause>` slices, so the
+# chrome view shows occupancy per chip at a glance. The cause label is a
+# trace-local approximation (dominant overlap with host pack /
+# compile-fallback spans); the exact attribution is the profiler's
+# `bls_device_bubble_seconds_total{shard,cause}` counters.
+# ---------------------------------------------------------------------------
+
+LANE_TID_BASE = 1 << 20  # synthetic tids: far above real thread ids
+DEVICE_STAGE_NAMES = ("bls.gather", "bls.stage1", "bls.stage2", "bls.stage3")
+MIN_BUBBLE_US = 20.0
+
+
+def _dominant_cause(g0: float, g1: float, causes) -> str:
+    """Largest-overlap host activity inside the gap [g0, g1] µs, or
+    ``other`` when nothing overlaps — the same name the profiler's
+    cause catalogue gives the uncovered remainder, so the trace slices
+    and the counters speak one vocabulary (trace-local label; the
+    profiler counters are the exact attribution)."""
+    best, best_overlap = "other", 0.0
+    acc: dict = {}
+    for cause, a0, a1 in causes:
+        ov = min(a1, g1) - max(a0, g0)
+        if ov > 0:
+            acc[cause] = acc.get(cause, 0.0) + ov
+    for cause, ov in acc.items():
+        if ov > best_overlap:
+            best, best_overlap = cause, ov
+    return best
+
+
+def add_device_lanes(trace: dict, min_bubble_us: float = MIN_BUBBLE_US) -> dict:
+    """Augment a chrome trace IN PLACE with per-shard device lanes:
+    device-stage spans (``bls.stage*``/``bls.gather``; falls back to
+    ``scheduler.sub_batch`` for stub replays that never reach a device)
+    are mirrored onto one synthetic lane per shard, and the gaps
+    between consecutive spans on a lane become ``bubble:<cause>``
+    slices. Returns {lanes, bubbles, source}."""
+    evs = trace["traceEvents"]
+    stage = [
+        e for e in evs
+        if e.get("ph") == "X" and e.get("name") in DEVICE_STAGE_NAMES
+    ]
+    source = "device_stage"
+    if not stage:
+        stage = [
+            e for e in evs
+            if e.get("ph") == "X" and e.get("name") == "scheduler.sub_batch"
+        ]
+        source = "sub_batch"
+    lanes: dict = {}
+    for e in stage:
+        shard = e.get("args", {}).get("shard")
+        shard = 0 if shard in (None, "None") else int(shard)
+        lanes.setdefault(shard, []).append(e)
+    causes = []
+    for e in evs:
+        if e.get("ph") != "X":
+            continue
+        if e.get("name") == "bls.pack":
+            causes.append(("pack", e["ts"], e["ts"] + e["dur"]))
+        elif e.get("name") == "compile_service.fallback_verify":
+            causes.append(("compile", e["ts"], e["ts"] + e["dur"]))
+    new = []
+    n_bubbles = 0
+    for shard, sevs in sorted(lanes.items()):
+        tid = LANE_TID_BASE + shard
+        pid = sevs[0]["pid"]
+        new.append({
+            "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+            "args": {"name": f"device shard {shard}"},
+        })
+        sevs.sort(key=lambda e: e["ts"])
+        last_end = None
+        for e in sevs:
+            if last_end is not None and e["ts"] - last_end > min_bubble_us:
+                cause = _dominant_cause(last_end, e["ts"], causes)
+                new.append({
+                    "name": f"bubble:{cause}", "ph": "X",
+                    "ts": round(last_end, 3),
+                    "dur": round(e["ts"] - last_end, 3),
+                    "pid": pid, "tid": tid,
+                    "args": {"shard": shard, "cause": cause},
+                })
+                n_bubbles += 1
+            lane_ev = dict(e)
+            lane_ev["tid"] = tid
+            new.append(lane_ev)
+            end = e["ts"] + e["dur"]
+            last_end = end if last_end is None else max(last_end, end)
+    trace["traceEvents"] = evs + new
+    return {"lanes": len(lanes), "bubbles": n_bubbles, "source": source}
+
+
+def write_trace_with_lanes(out_path: str) -> tuple:
+    """Export the recorded spans + per-shard device lanes to
+    ``out_path``; returns (event count, lane info)."""
+    from lighthouse_tpu.utils import tracing
+
+    trace = tracing.chrome_trace()
+    lane_info = add_device_lanes(trace)
+    with open(out_path, "w") as f:
+        json.dump(trace, f)
+    return len(trace["traceEvents"]), lane_info
 
 
 def build_sets(n_sets: int, committee: int, n_msgs: int):
@@ -91,13 +208,14 @@ def replay_main(args) -> None:
         deadline_ms=args.deadline_ms,
         time_scale=args.time_scale,
     )
-    n = tracing.export_chrome(args.out)
+    n, lane_info = write_trace_with_lanes(args.out)
     print(
         json.dumps(
             {
                 "trace": args.out,
                 "events": n,
                 "dropped": tracing.dropped(),
+                "device_lanes": lane_info,
                 "replayed": {
                     "trace_file": args.replay,
                     "name": header.get("name"),
@@ -160,13 +278,14 @@ def main(argv=None) -> None:
                 ok = backend.verify_signature_sets(sets)
     assert ok is True, "trace workload must verify"
 
-    n = tracing.export_chrome(args.out)
+    n, lane_info = write_trace_with_lanes(args.out)
     print(
         json.dumps(
             {
                 "trace": args.out,
                 "events": n,
                 "dropped": tracing.dropped(),
+                "device_lanes": lane_info,
                 "verdict": bool(ok),
                 "stage_latency": stage_quantile_summary(),
             }
