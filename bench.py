@@ -746,6 +746,90 @@ def _replay_data_movement(
     }
 
 
+def measure_chaos_leg(
+    use_cpu: bool,
+    generator: str = "gossip_steady",
+    seed: int = 5,
+    duration_s: float = 6.0,
+    time_scale: float = 0.5,
+    deadline_ms: float = 100.0,
+) -> dict:
+    """Self-healing under chaos (ISSUE 13): a gossip-steady replay on a
+    2-shard mesh with one INJECTED shard loss and an in-replay
+    recovery — kill → probation (backoff probes through the same
+    verify seam) → re-admission — recording the SLO miss ratio during
+    degradation, the time-to-recover, and post-recovery sets/s (the
+    dp axis must come back, not just survive). Stub backend in a
+    SUBPROCESS: the leg certifies the RECOVERY machinery's latency
+    economics, which live entirely in the scheduling layer (the
+    staged-device half of degradation is `tests/test_zgate8_multichip`;
+    the chaos gate is `tests/test_zgate9_chaos`). bench_diff gates
+    `time_to_recover_s` — a recovery that slows >20% is a regression
+    in the node's capacity restoration, exactly what the committee
+    cost model assumes never leaks (PAPERS.md arxiv 2302.00418)."""
+    replay = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools",
+        "traffic_replay.py",
+    )
+    leg_timeout = min(240.0, _budget_left() - 60)
+    if leg_timeout < 60:
+        return {"skipped": "budget"}
+    env = dict(os.environ)
+    if use_cpu:
+        env["JAX_PLATFORMS"] = "cpu"
+    try:
+        r = subprocess.run(
+            [sys.executable, replay,
+             "--generate", generator, "--seed", str(seed),
+             "--duration", str(duration_s),
+             "--time-scale", str(time_scale),
+             "--deadline-ms", str(deadline_ms),
+             "--dp", "2", "--kill-shard", "1", "--kill-after", "3",
+             "--revive-shard", "1", "--revive-after", "10",
+             "--probe-base-s", "0.1",
+             "--verify", "stub:0.001", "--json"],
+            capture_output=True, text=True, timeout=leg_timeout, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return {"skipped": f"timeout>{leg_timeout:.0f}s"}
+    if r.returncode != 0:
+        return {"error": f"rc={r.returncode}: {r.stderr[-200:]}"}
+    try:
+        report = json.loads(r.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return {"error": f"unparseable output: {r.stdout[-200:]}"}
+    rec = report.get("recovery") or {}
+    mesh = report.get("mesh") or {}
+    if not rec.get("recovered"):
+        # a chaos leg that never exercised recovery must be LOUD: the
+        # gated time_to_recover_s is absent and bench_diff reports the
+        # skipped gate instead of silently passing
+        return {
+            "error": "injected shard loss did not recover in-replay",
+            "recovery": rec,
+        }
+    return {
+        "generator": generator,
+        "seed": seed,
+        "time_scale": time_scale,
+        "deadline_ms": deadline_ms,
+        "verify_backend": report["config"]["verify_backend"],
+        "n_events": report["n_events"],
+        "n_sets": report["n_sets"],
+        "wall_s": report["wall_s"],
+        "verdicts": report["verdicts"],
+        "time_to_recover_s": rec["time_to_recover_s"],
+        "probes": rec["probes"],
+        "flushes_served_degraded": rec["flushes_served_degraded"],
+        "sets_served_degraded": rec["sets_served_degraded"],
+        "slo_miss_ratio_degraded": rec["slo_miss_ratio_degraded"],
+        "post_recovery_sets_per_sec": rec.get("post_recovery_sets_per_sec"),
+        "recoveries_total": mesh.get("recoveries_total"),
+        "healthy_shards_final": mesh.get("healthy_shards"),
+        "deadline_misses_total": report["slo"]["deadline_misses_total"],
+    }
+
+
 def measure_dp_leg(
     n_sets: int = 16, reps: int = 3, messages: int = 2
 ) -> dict:
@@ -1212,6 +1296,18 @@ def main() -> None:
         except Exception as e:  # the leg must not kill the line
             replay_leg = {"error": str(e)[:200]}
 
+    # Chaos leg (ISSUE 13): injected shard loss + in-replay recovery on
+    # a 2-shard mesh — SLO miss ratio during degradation,
+    # time-to-recover (gated by tools/bench_diff.py) and post-recovery
+    # sets/s. Subprocess, budget-guarded, stub backend (seconds).
+    if _budget_left() < 120:
+        chaos_leg = {"skipped": "budget"}
+    else:
+        try:
+            chaos_leg = measure_chaos_leg(use_cpu)
+        except Exception as e:  # the leg must not kill the line
+            chaos_leg = {"error": str(e)[:200]}
+
     # Served multi-chip dp verify, 1 vs 2 virtual devices (ISSUE 11):
     # per-chip + aggregate sets/s through the real scheduler/planner/
     # backend stack. Subprocesses (XLA_FLAGS must precede jax init),
@@ -1310,6 +1406,7 @@ def main() -> None:
                 "pipeline_leg": pipeline_leg,
                 "key_table_leg": key_table_leg,
                 "replay_leg": replay_leg,
+                "chaos_leg": chaos_leg,
                 "dp_leg": dp_leg,
                 "startup": startup,
                 "buckets": buckets,

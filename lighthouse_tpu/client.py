@@ -136,13 +136,19 @@ class Client:
                 if listener is not None:
                     self.chain.pubkey_cache.unsubscribe(listener)
                     self.chain._key_table_listener = None
+                # cancel any pending re-sync retry timer: a stopped
+                # client's table must not keep syncing in the background
+                ktable.close()
             mesh = getattr(self.chain, "device_mesh", None)
             if mesh is not None:
                 # last: everything above may still dispatch through the
                 # mesh while draining. Detach only OUR mesh — a racing
-                # rebuild must not lose its fresh one.
+                # rebuild must not lose its fresh one. The recovery
+                # worker stops FIRST with a bounded join — stop() during
+                # an active probation probe must never wedge (ISSUE 13).
                 from .crypto.device import mesh as _mesh_mod
 
+                mesh.stop_recovery()
                 _mesh_mod.clear_mesh(mesh)
             self.processor.shutdown()
             self.persist()
@@ -392,6 +398,11 @@ class ClientBuilder:
                         want = None if env_n == "all" else (env_n or 1)
                     mesh = mesh_mod.DeviceMesh(n_devices=want)
                     mesh_mod.set_mesh(mesh)
+                    if mesh_mod.recovery_env_enabled():
+                        # self-healing (ISSUE 13): lost chips enter
+                        # probation and a background probe re-admits
+                        # them (canary -> re-warm -> key-table re-sync)
+                        mesh.start_recovery()
                 except Exception as e:
                     from .utils import logging as tlog
 
@@ -417,8 +428,12 @@ class ClientBuilder:
                     )
                     ktable.sync(reason="startup")
                     _key_table.set_table(ktable)
+                    # sync_or_schedule (ISSUE 13): a failed delta
+                    # schedules a full-sync retry with backoff instead
+                    # of degrading batches to raw packs forever
                     listener = (
-                        lambda _cache, _t=ktable: _t.sync(reason="delta")
+                        lambda _cache, _t=ktable:
+                        _t.sync_or_schedule(reason="delta")
                     )
                     chain.pubkey_cache.subscribe(listener)
                     # stop() must be able to detach it, or admissions

@@ -41,12 +41,14 @@ is imported lazily.
 from __future__ import annotations
 
 import os
+import random
 import threading
 import time
 from collections import deque
 from typing import Callable, Iterable, Optional, Sequence, Tuple
 
 from ..utils import (
+    fault_injection,
     flight_recorder,
     metrics,
     pipeline_profiler,
@@ -84,6 +86,31 @@ DEFAULT_RUNGS: Tuple[Rung, ...] = (
 
 _ENV_ENABLED = "LIGHTHOUSE_TPU_COMPILE_SERVICE"
 _ENV_RUNGS = "LIGHTHOUSE_TPU_COMPILE_RUNGS"
+# compile retry (ISSUE 13): a compile_failed rung re-queues with
+# bounded exponential backoff + jitter instead of dying — a transient
+# XLA/tunnel error must not leave a rung permanently cold — capped at
+# a per-rung attempt budget so a deterministic failure cannot spin
+_ENV_RETRY_MAX = "LIGHTHOUSE_TPU_COMPILE_RETRY_MAX"
+_ENV_RETRY_BASE = "LIGHTHOUSE_TPU_COMPILE_RETRY_BASE_S"
+_ENV_RETRY_CAP = "LIGHTHOUSE_TPU_COMPILE_RETRY_MAX_S"
+
+DEFAULT_RETRY_MAX_ATTEMPTS = 3
+DEFAULT_RETRY_BASE_S = 1.0
+DEFAULT_RETRY_MAX_S = 60.0
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, ""))
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, ""))
+    except ValueError:
+        return default
 
 _COMPILE_BUCKETS = (
     0.01, 0.05, 0.25, 1.0, 5.0, 15.0, 30.0, 60.0, 120.0, 300.0, 600.0, 1200.0,
@@ -122,6 +149,13 @@ _COLD_ROUTES = metrics.counter_vec(
     "on a larger warm rung, shed = served via the synchronous CPU-native "
     "fallback while the rung compiles in the background",
     ("action",),
+)
+_COMPILE_RETRIES = metrics.counter(
+    "compile_service_compile_retries_total",
+    "failed rung compiles re-queued with backoff by the retry layer "
+    "(ISSUE 13; see the compile_retry journal kind) — retries beyond "
+    "the per-rung attempt cap are NOT scheduled and the rung stays "
+    "cold until invalidate()/demand re-queues it",
 )
 _FALLBACK_SECONDS = metrics.histogram(
     "compile_service_fallback_verify_seconds",
@@ -281,6 +315,16 @@ class CompileService:
         self._compiled_total = 0
         self._failed_total = 0
         self._cold_routes = {"padded": 0, "shed": 0}
+        # compile retry (ISSUE 13): per-(rung, device) failed-attempt
+        # counts and the delayed re-queue the worker promotes when due
+        self.retry_max_attempts = max(
+            1, _env_int(_ENV_RETRY_MAX, DEFAULT_RETRY_MAX_ATTEMPTS)
+        )
+        self.retry_base_s = _env_float(_ENV_RETRY_BASE, DEFAULT_RETRY_BASE_S)
+        self.retry_max_s = _env_float(_ENV_RETRY_CAP, DEFAULT_RETRY_MAX_S)
+        self._attempts: dict = {}   # (rung, device) -> failures so far
+        self._retry_at: dict = {}   # (rung, device) -> due monotonic time
+        self._retries_total = 0
 
     # -- lifecycle --------------------------------------------------------
 
@@ -342,6 +386,10 @@ class CompileService:
         with self._cv:
             self._queue.clear()
             self._queued.clear()
+            # retry state is per-epoch: the re-queued plan below starts
+            # every rung with a fresh failure budget
+            self._retry_at.clear()
+            self._attempts.clear()
             for rung in self.plan:
                 for dev in self._devices:
                     # even_in_flight: a rung compiling RIGHT NOW finishes
@@ -375,7 +423,10 @@ class CompileService:
             from ..crypto.device import mesh as _mesh
 
             m = _mesh.get_active_mesh()
-            return m is None or m.is_healthy(dev)
+            # a PROBING shard's rungs are live work: the recovery
+            # worker's re-warm (ISSUE 13) queues them before the shard
+            # is re-admitted, so they must not be skipped as dead
+            return m is None or m.is_healthy(dev) or m.is_probing(dev)
         except Exception:
             return True
 
@@ -687,14 +738,21 @@ class CompileService:
         me = threading.current_thread()
         while True:
             with self._cv:
-                while (
-                    not self._queue
-                    and not self._stopped
-                    and self._thread is me
-                ):
-                    self._cv.wait()
-                if self._stopped or self._thread is not me:
-                    return
+                while True:
+                    if self._stopped or self._thread is not me:
+                        return
+                    self._promote_due_retries_locked()
+                    if self._queue:
+                        break
+                    # sleep until the earliest pending retry is due (or
+                    # indefinitely when none is scheduled)
+                    wait = None
+                    if self._retry_at:
+                        wait = max(
+                            0.01,
+                            min(self._retry_at.values()) - time.monotonic(),
+                        )
+                    self._cv.wait(wait)
                 rung = self._queue.popleft()
                 self._queued.discard(rung)
                 self._in_flight = rung
@@ -710,6 +768,53 @@ class CompileService:
                     if self._in_flight == rung:
                         self._in_flight = None
                         _IN_FLIGHT.set(0)
+
+    def _promote_due_retries_locked(self) -> None:
+        """Move due retry items back onto the work queue (called under
+        the cv by the worker loop)."""
+        if not self._retry_at:
+            return
+        now = time.monotonic()
+        due = [it for it, t in self._retry_at.items() if t <= now]
+        for it in due:
+            del self._retry_at[it]
+            if it not in self._queued and it != self._in_flight:
+                self._queued.add(it)
+                self._queue.append(it)
+        if due:
+            _QUEUE_DEPTH.set(len(self._queue))
+
+    def _schedule_retry(self, rung: Rung, dev: int, impl: str,
+                        error: BaseException) -> None:
+        """One rung compile failed: re-queue it with bounded backoff +
+        jitter unless its per-rung attempt budget is spent (the
+        monitoring.py retry shape — a deterministic failure must not
+        spin, a transient one must not leave the rung cold forever)."""
+        key = (rung, int(dev))
+        with self._cv:
+            attempts = self._attempts.get(key, 0) + 1
+            self._attempts[key] = attempts
+            if attempts >= self.retry_max_attempts:
+                return  # budget spent: the rung stays cold (journaled)
+            if key in self._queued or key in self._retry_at:
+                return
+            delay = min(
+                self.retry_max_s,
+                self.retry_base_s * (2.0 ** (attempts - 1)),
+            ) * random.uniform(0.5, 1.0)
+            self._retry_at[key] = time.monotonic() + delay
+            self._retries_total += 1
+            self._cv.notify_all()
+        _COMPILE_RETRIES.inc()
+        b, k, m = rung
+        flight_recorder.record(
+            "compile_retry",
+            b=b, k=k, m=m, fp_impl=impl, device=dev,
+            attempt=attempts,
+            max_attempts=self.retry_max_attempts,
+            delay_s=round(delay, 3),
+            error=repr(error)[:200],
+        )
 
     def _compile_rung(self, item) -> None:
         # item is ((B, K, M), device); a bare (B, K, M) means device 0
@@ -737,6 +842,10 @@ class CompileService:
                 "compile_service.compile", b=b, k=k, m=m, fp_impl=impl,
                 device=dev,
             ):
+                # chaos seam (ISSUE 13): an armed `compile` fault point
+                # raises here and exercises the retry layer exactly
+                # like a real XLA failure would
+                fault_injection.fire("compile")
                 if self._compile_rung_fn is not None:
                     stages = self._compile_rung_fn(b, k, m)
                 else:
@@ -770,6 +879,7 @@ class CompileService:
             flight_recorder.record(
                 "compile_failed", b=b, k=k, m=m, fp_impl=impl,
                 error=repr(e)[:200], device=dev,
+                attempt=self._attempts.get((rung, dev), 0) + 1,
             )
             from ..utils import logging as tlog
 
@@ -778,8 +888,15 @@ class CompileService:
                 b=b, k=k, m=m, fp_impl=impl, device=dev,
                 error=repr(e)[:120],
             )
+            # retry with backoff (ISSUE 13): the rung re-queues instead
+            # of dying, up to the per-rung attempt cap
+            self._schedule_retry(rung, dev, impl, e)
             return
         seconds = time.perf_counter() - t0
+        # a success retires the rung's failure budget: the next
+        # transient failure (after an invalidate) starts fresh
+        with self._cv:
+            self._attempts.pop((rung, dev), None)
         for stage, rec in (stages or {}).items():
             _COMPILES.with_labels(stage, "ok").inc()
             _COMPILE_SECONDS.with_labels(stage).observe(
@@ -834,6 +951,12 @@ class CompileService:
             failed_total = self._failed_total
             cold_routes = dict(self._cold_routes)
             devices = self._devices
+            now = time.monotonic()
+            retry_pending = [
+                [*rung, dev, round(max(0.0, due - now), 2)]
+                for (rung, dev), due in sorted(self._retry_at.items())
+            ]
+            retries_total = self._retries_total
         prebaked = []
         if self.manifest is not None:
             try:
@@ -860,6 +983,12 @@ class CompileService:
             "compiled_total": compiled_total,
             "failed_total": failed_total,
             "cold_routes": cold_routes,
+            "retry": {
+                "max_attempts": self.retry_max_attempts,
+                "base_s": self.retry_base_s,
+                "retries_total": retries_total,
+                "pending": retry_pending,
+            },
             "cache": {**self.cache_status, "prebaked_rungs": [list(r) for r in prebaked]},
         }
         if multi:

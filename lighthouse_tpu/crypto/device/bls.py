@@ -41,6 +41,7 @@ import jax
 import jax.numpy as jnp
 
 from ...utils import (
+    fault_injection,
     flight_recorder,
     metrics,
     pipeline_profiler,
@@ -508,6 +509,11 @@ def _run_stage(stage: str, fn, *args):
     journal per-stage attribution."""
     from . import mesh as _mesh_mod
 
+    # chaos seam (ISSUE 13): an armed `staged_dispatch` fault point
+    # raises (or stalls) here — inside the sharded dispatch scope, so
+    # the scheduler's failover/watchdog/probation machinery sees it
+    # exactly where a real chip failure would surface
+    fault_injection.fire("staged_dispatch")
     impl = fp.get_impl()
     shard = _mesh_mod.current_shard() or 0
     key = (
@@ -938,6 +944,7 @@ def pack_signature_sets_raw(
     t_hash = time.perf_counter() - t0
 
     t0 = time.perf_counter()
+    fault_injection.fire("device_put")  # chaos seam (ISSUE 13)
     args = (
         jnp.asarray(pk_xy),
         jnp.asarray(pk_mask),
@@ -1051,6 +1058,7 @@ def pack_signature_sets_indexed(
     t_hash = time.perf_counter() - t0
 
     t0 = time.perf_counter()
+    fault_injection.fire("device_put")  # chaos seam (ISSUE 13)
     args = (
         jnp.asarray(pk_idx),
         jnp.asarray(pk_mask),

@@ -57,12 +57,13 @@ from __future__ import annotations
 
 import hashlib
 import os
+import random
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ...utils import flight_recorder, metrics
+from ...utils import fault_injection, flight_recorder, metrics
 
 # limbs per field element; pinned == fp.NL by test (this module must not
 # import the device fp module, which pulls jax)
@@ -78,10 +79,18 @@ CAPACITY_LADDER = (1024, 4096, 16384, 65536, 262144, 1048576)
 _ENV_ENABLED = "LIGHTHOUSE_TPU_KEY_TABLE"
 _ENV_MAX_AGG = "LIGHTHOUSE_TPU_KEY_TABLE_MAX_AGG"
 _ENV_CHUNK = "LIGHTHOUSE_TPU_KEY_TABLE_CHUNK"
+# re-sync retry (ISSUE 13): a failed admission-listener delta schedules
+# a full-sync retry with capped exponential backoff + jitter instead of
+# degrading to raw packs forever (sync always catches the mirror up to
+# the whole host cache, so one retry covers any number of missed deltas)
+_ENV_RESYNC_BASE = "LIGHTHOUSE_TPU_KEY_TABLE_RESYNC_BASE_S"
+_ENV_RESYNC_MAX = "LIGHTHOUSE_TPU_KEY_TABLE_RESYNC_MAX_S"
 
 DEFAULT_MAX_AGGREGATES = 4096
 DEFAULT_UPLOAD_CHUNK_ROWS = 65536
 DEFAULT_AGG_MIN_REPEATS = 2
+DEFAULT_RESYNC_BASE_S = 1.0
+DEFAULT_RESYNC_MAX_S = 60.0
 # the repeat-counting sketch is bounded too: when it exceeds this many
 # distinct tuples it resets wholesale (it only gates INSERTS; losing it
 # costs one extra sighting before a tuple collapses again)
@@ -100,6 +109,13 @@ def table_capacity(n: int) -> int:
 
 def env_enabled() -> bool:
     return os.environ.get(_ENV_ENABLED, "1") not in ("", "0")
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, ""))
+    except ValueError:
+        return default
 
 
 class KeyTableError(RuntimeError):
@@ -141,6 +157,15 @@ _SETS = metrics.counter_vec(
     "key not resident, so the whole batch fell back to the G1 limb "
     "plane. hit ratio = (indexed+collapsed) / all",
     ("path",),
+)
+_RESYNCS = metrics.counter_vec(
+    "bls_device_key_table_resyncs_total",
+    "full-sync retries after a failed mirror sync (ISSUE 13): "
+    "scheduled = a retry timer armed with backoff, ok = a retry "
+    "caught the mirror up, error = a retry failed (and re-scheduled) "
+    "— a failed admission delta degrades batches to raw packs only "
+    "until the retry lands, never forever",
+    ("outcome",),
 )
 _AGG_EVENTS = metrics.counter_vec(
     "bls_device_key_table_agg_events_total",
@@ -223,6 +248,15 @@ class DeviceKeyTable:
         self._sets = {"indexed": 0, "collapsed": 0, "raw": 0}
         self._agg_hits = 0
         self._agg_inserts = 0
+        # re-sync retry state (ISSUE 13): one pending timer at a time,
+        # backoff grows with consecutive failures, close() cancels
+        self._resync_lock = threading.Lock()
+        self._resync_base_s = _env_float(_ENV_RESYNC_BASE, DEFAULT_RESYNC_BASE_S)
+        self._resync_max_s = _env_float(_ENV_RESYNC_MAX, DEFAULT_RESYNC_MAX_S)
+        self._resync_failures = 0
+        self._resync_timer: Optional[threading.Timer] = None
+        self._resyncs = {"scheduled": 0, "ok": 0, "error": 0}
+        self._closed = False
 
     # -- mesh replication helpers (ISSUE 11) ------------------------------
 
@@ -284,6 +318,10 @@ class DeviceKeyTable:
         verifier thread and the block-import listener behind host
         packing. The commit re-checks the snapshots and retries on the
         (rare: builder + admission listener) concurrent-sync race."""
+        # chaos seam (ISSUE 13): an armed `key_table_sync` fault point
+        # raises here — before any state is touched, like every real
+        # sync failure — and exercises the re-sync retry layer
+        fault_injection.fire("key_table_sync")
         shards = self._replica_shards()
         for _attempt in range(16):
             with self._lock:
@@ -461,6 +499,79 @@ class DeviceKeyTable:
             ]
             staged = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
             return dev.at[offset: offset + len(rows)].set(staged)
+
+    # -- re-sync retry (ISSUE 13) -----------------------------------------
+
+    def sync_or_schedule(self, reason: str = "delta") -> Optional[int]:
+        """The admission listener's entry: try the sync; on failure
+        schedule a full-sync retry with backoff and return None instead
+        of raising into the admission path. The table serves what it
+        has meanwhile — non-resident keys fall back to the raw pack,
+        verdict-identical, until the retry catches the mirror up."""
+        try:
+            n = self.sync(reason=reason)
+        except Exception as e:
+            self._schedule_resync(e)
+            return None
+        with self._resync_lock:
+            self._resync_failures = 0
+        return n
+
+    def _schedule_resync(self, error: BaseException) -> None:
+        with self._resync_lock:
+            if self._closed:
+                return
+            self._resync_failures += 1
+            fails = self._resync_failures
+            if self._resync_timer is not None:
+                return  # one pending retry at a time; it re-syncs fully
+            delay = min(
+                self._resync_max_s,
+                self._resync_base_s * (2.0 ** (fails - 1)),
+            ) * random.uniform(0.5, 1.0)
+            t = threading.Timer(delay, self._resync_run)
+            t.daemon = True
+            self._resync_timer = t
+            self._resyncs["scheduled"] += 1
+            t.start()
+        _RESYNCS.with_labels("scheduled").inc()
+        from ...utils import logging as tlog
+
+        tlog.log(
+            "warn",
+            "key-table sync failed — full-sync retry scheduled",
+            failures=fails, delay_s=round(delay, 3),
+            error=repr(error)[:120],
+        )
+
+    def _resync_run(self) -> None:
+        with self._resync_lock:
+            self._resync_timer = None
+            if self._closed:
+                return
+        try:
+            self.sync(reason="recovery")
+        except Exception as e:
+            with self._resync_lock:
+                self._resyncs["error"] += 1
+            _RESYNCS.with_labels("error").inc()
+            self._schedule_resync(e)
+            return
+        with self._resync_lock:
+            self._resync_failures = 0
+            self._resyncs["ok"] += 1
+        _RESYNCS.with_labels("ok").inc()
+
+    def close(self) -> None:
+        """Stop the retry machinery (``Client.stop()``): cancel any
+        pending re-sync timer and refuse new ones — a stopped client's
+        table must not keep syncing in the background."""
+        with self._resync_lock:
+            self._closed = True
+            t = self._resync_timer
+            self._resync_timer = None
+        if t is not None:
+            t.cancel()
 
     # -- resolution (the static/dynamic packer decision) ------------------
 
@@ -770,6 +881,9 @@ class DeviceKeyTable:
                 "sets": sets,
                 "hit_ratio": round(shipped / total, 4) if total else None,
                 "identity_pinned": self._n <= len(self.cache.pubkeys),
+                "resyncs": dict(self._resyncs),
+                "resync_failures": self._resync_failures,
+                "resync_pending": self._resync_timer is not None,
             }
 
 

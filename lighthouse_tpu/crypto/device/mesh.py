@@ -31,6 +31,24 @@ counts, lost/healthy state and per-chip ``device_memory_bytes`` feed
 the ``bls_device_shard_*`` families and the ``/lighthouse/health``
 ``mesh`` block; shard transitions journal ``shard_lost`` events.
 
+Self-healing (ISSUE 13): a lost shard is not gone forever — it enters
+**probation**: a background recovery worker (:meth:`DeviceMesh.start_
+recovery`, the client builder owns the lifecycle) probes it on a
+capped exponential backoff with jitter (the ``utils/monitoring.py``
+retry shape: ``base * 2**(attempt-1)`` capped, ``* U[0.5, 1.0]`` so a
+fleet never probes in lockstep). One probe = canary verify on the chip
+(a tiny device computation, or an injected ``probe_fn`` — the replay
+driver probes through the real verify seam) → best-effort re-warm of
+the compile plan's rungs on that device (warm rungs are no-ops: the
+executables survived the loss, so the certified recovery pays ZERO
+fresh staged compiles) → key-table replica re-sync (a failure here
+fails the probe — a shard must never re-admit with a stale replica) →
+re-admission to the planner's shard axis. Every transition journals
+(``shard_probation`` per entry/failed probe with the next backoff,
+``shard_recovered`` on re-admission) and the ``mesh`` health block
+carries probation state + recovery counters. The reference's peer
+manager scores, bans AND un-bans; this is that loop for chips.
+
 Mesh discovery order (the client builder owns the lifecycle):
 ``ClientConfig.dp_devices`` > env ``LIGHTHOUSE_TPU_DP_DEVICES`` > all
 local devices of the active backend. A virtual mesh on a single-host
@@ -48,6 +66,7 @@ shape the jax-free scheduler/planner tests drive.
 from __future__ import annotations
 
 import os
+import random
 import threading
 import time
 from collections import deque
@@ -57,6 +76,12 @@ from ...utils import flight_recorder, metrics
 
 _ENV_ENABLED = "LIGHTHOUSE_TPU_DP_MESH"
 _ENV_DEVICES = "LIGHTHOUSE_TPU_DP_DEVICES"
+_ENV_RECOVERY = "LIGHTHOUSE_TPU_MESH_RECOVERY"
+_ENV_PROBE_BASE = "LIGHTHOUSE_TPU_MESH_PROBE_BASE_S"
+_ENV_PROBE_MAX = "LIGHTHOUSE_TPU_MESH_PROBE_MAX_S"
+
+DEFAULT_PROBE_BASE_S = 1.0
+DEFAULT_PROBE_MAX_S = 30.0
 
 # rolling per-chip throughput window (seconds): short enough that a
 # stalled chip's sets/s visibly decays on the health page, long enough
@@ -66,6 +91,20 @@ _RATE_WINDOW_S = 60.0
 
 def env_enabled() -> bool:
     return os.environ.get(_ENV_ENABLED, "1") not in ("", "0")
+
+
+def recovery_env_enabled() -> bool:
+    """Kill switch for the self-healing worker (ISSUE 13): default on —
+    a node that can recover a chip should; 0 pins the pre-recovery
+    one-way degradation."""
+    return os.environ.get(_ENV_RECOVERY, "1") not in ("", "0")
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, ""))
+    except ValueError:
+        return default
 
 
 def env_devices():
@@ -120,12 +159,35 @@ _SHARD_MEMORY = metrics.gauge_vec(
     "backend reports them, else live-buffer sum attributed by device)",
     ("shard",),
 )
+_SHARD_PROBATION = metrics.gauge_vec(
+    "bls_device_shard_probation",
+    "1 = shard is in probation (lost from the axis, the recovery "
+    "worker is probing it on backoff), 0 = not (healthy, or lost with "
+    "recovery disabled)",
+    ("shard",),
+)
+_SHARD_PROBES = metrics.counter_vec(
+    "bls_device_shard_probes_total",
+    "recovery probes run against a probation shard, by outcome (ok = "
+    "canary + re-warm + key-table re-sync all passed and the shard "
+    "was re-admitted; error = the probe failed and the next one backs "
+    "off further)",
+    ("shard", "outcome"),
+)
+_SHARD_RECOVERIES = metrics.counter_vec(
+    "bls_device_shard_recoveries_total",
+    "probation shards re-admitted to the planner's shard axis by the "
+    "recovery worker (see the shard_recovered journal kind)",
+    ("shard",),
+)
 
 
 class _ShardState:
     __slots__ = (
         "healthy", "failures", "sets_total", "dispatches",
         "last_dispatch_t", "window", "lost_error",
+        "probation", "probe_attempts", "next_probe_t", "lost_at",
+        "recovered_total",
     )
 
     def __init__(self):
@@ -136,6 +198,13 @@ class _ShardState:
         self.last_dispatch_t: Optional[float] = None
         self.window: deque = deque()  # (t, n_sets)
         self.lost_error: Optional[str] = None
+        # probation/recovery (ISSUE 13): set on the healthy->lost
+        # transition, cleared on re-admission (or operator restore)
+        self.probation = False
+        self.probe_attempts = 0
+        self.next_probe_t: Optional[float] = None
+        self.lost_at: Optional[float] = None
+        self.recovered_total = 0
 
 
 class DeviceMesh:
@@ -149,6 +218,9 @@ class DeviceMesh:
         self,
         n_devices: Optional[int] = None,
         devices: Optional[Sequence] = None,
+        probe_fn=None,
+        probe_base_s: Optional[float] = None,
+        probe_max_s: Optional[float] = None,
     ):
         if devices is None:
             import jax
@@ -175,6 +247,24 @@ class DeviceMesh:
         }
         for i in self._shards:
             _SHARD_HEALTH.with_labels(str(i)).set(1)
+        # recovery worker (ISSUE 13): idle until start_recovery(); the
+        # probe callable is injectable so chaos tooling and jax-free
+        # tests can probe through the real verify seam
+        self._probe_fn = probe_fn
+        self._probe_base_s = (
+            float(probe_base_s)
+            if probe_base_s is not None
+            else _env_float(_ENV_PROBE_BASE, DEFAULT_PROBE_BASE_S)
+        )
+        self._probe_max_s = (
+            float(probe_max_s)
+            if probe_max_s is not None
+            else _env_float(_ENV_PROBE_MAX, DEFAULT_PROBE_MAX_S)
+        )
+        self._rec_cv = threading.Condition()
+        self._rec_stop = False
+        self._rec_thread: Optional[threading.Thread] = None
+        self._recoveries_total = 0
 
     # -- topology ---------------------------------------------------------
 
@@ -192,6 +282,21 @@ class DeviceMesh:
         with self._lock:
             st = self._shards.get(shard)
             return st is not None and st.healthy
+
+    def is_probing(self, shard: int) -> bool:
+        """True while ``shard`` is in probation — lost from the axis
+        but under active recovery. The compile service treats a
+        probing shard's rungs as live work (the re-warm half of a
+        probe), unlike a plainly lost shard's."""
+        with self._lock:
+            st = self._shards.get(shard)
+            return st is not None and st.probation
+
+    def probing_shards(self) -> List[int]:
+        with self._lock:
+            return sorted(
+                i for i, s in self._shards.items() if s.probation
+            )
 
     def primary_shard(self) -> Optional[int]:
         """The default dispatch target when no shard context is set:
@@ -268,18 +373,258 @@ class DeviceMesh:
                 "warn", "mesh shard lost — degrading to fewer dp shards",
                 shard=shard, error=repr(error)[:120],
             )
+            # a lost chip enters probation immediately (the state is
+            # set whether or not a recovery worker runs: the worker
+            # reads it, tooling and the health page report it)
+            self._enter_probation(shard, error)
         return transition
 
     def restore_shard(self, shard: int) -> None:
         """Operator action (or test hook): put a repaired chip back on
-        the shard axis."""
+        the shard axis. Also the recovery worker's re-admission commit
+        — probation state clears with the restore."""
         with self._lock:
             st = self._shards.get(shard)
             if st is None:
                 return
             st.healthy = True
             st.lost_error = None
+            st.probation = False
+            st.probe_attempts = 0
+            st.next_probe_t = None
         _SHARD_HEALTH.with_labels(str(shard)).set(1)
+        _SHARD_PROBATION.with_labels(str(shard)).set(0)
+
+    # -- probation / recovery (ISSUE 13) ----------------------------------
+
+    def _backoff(self, attempt: int) -> float:
+        """Capped exponential backoff with jitter — the
+        ``utils/monitoring.py`` retry shape: ``base * 2**(attempt-1)``
+        capped at the max, times ``U[0.5, 1.0]`` so a fleet of nodes
+        losing chips to one shared cause never probes in lockstep."""
+        backoff = min(
+            self._probe_max_s,
+            self._probe_base_s * (2.0 ** max(0, attempt - 1)),
+        )
+        return backoff * random.uniform(0.5, 1.0)
+
+    def _enter_probation(self, shard: int, error: BaseException) -> None:
+        delay = self._backoff(1)
+        now = time.monotonic()
+        with self._lock:
+            st = self._shards.get(shard)
+            if st is None or st.probation:
+                return
+            st.probation = True
+            st.probe_attempts = 0
+            st.lost_at = now
+            st.next_probe_t = now + delay
+        _SHARD_PROBATION.with_labels(str(shard)).set(1)
+        flight_recorder.record(
+            "shard_probation",
+            shard=shard,
+            attempt=0,
+            next_probe_s=round(delay, 3),
+            error=repr(error)[:200],
+        )
+        with self._rec_cv:
+            self._rec_cv.notify_all()
+
+    def start_recovery(
+        self,
+        probe_fn=None,
+        base_backoff_s: Optional[float] = None,
+        max_backoff_s: Optional[float] = None,
+    ) -> "DeviceMesh":
+        """Start the background recovery worker (idempotent). The
+        worker probes probation shards on their backoff schedule; one
+        passing probe (canary + re-warm + key-table re-sync) re-admits
+        the shard to the planner's axis. Parameters override the ctor/
+        env config — chaos tooling shortens the backoff and injects a
+        probe through the real verify seam."""
+        with self._rec_cv:
+            if probe_fn is not None:
+                self._probe_fn = probe_fn
+            if base_backoff_s is not None:
+                self._probe_base_s = float(base_backoff_s)
+            if max_backoff_s is not None:
+                self._probe_max_s = float(max_backoff_s)
+            if self._rec_thread is not None and self._rec_thread.is_alive():
+                return self
+            self._rec_stop = False
+            self._rec_thread = threading.Thread(
+                target=self._recovery_loop, name="mesh-recovery",
+                daemon=True,
+            )
+            self._rec_thread.start()
+        return self
+
+    def stop_recovery(self, timeout: float = 10.0) -> None:
+        """Stop the recovery worker. A probe in flight gets ``timeout``
+        to finish; past that the (daemon) thread is abandoned — the
+        identity check in the loop makes a later ``start_recovery``
+        safe, and ``Client.stop()`` during an active probe never
+        wedges on it (pinned by test)."""
+        with self._rec_cv:
+            self._rec_stop = True
+            self._rec_cv.notify_all()
+        t = self._rec_thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=timeout)
+        self._rec_thread = None
+
+    def recovery_running(self) -> bool:
+        t = self._rec_thread
+        return t is not None and t.is_alive() and not self._rec_stop
+
+    def _due_probes(self):
+        """(due shard list, seconds until the earliest pending probe or
+        None) — called under no lock; takes the state lock itself."""
+        now = time.monotonic()
+        due: List[int] = []
+        nxt: Optional[float] = None
+        with self._lock:
+            for i, st in self._shards.items():
+                if not st.probation or st.next_probe_t is None:
+                    continue
+                if st.next_probe_t <= now:
+                    due.append(i)
+                elif nxt is None or st.next_probe_t < nxt:
+                    nxt = st.next_probe_t
+        wait = None if nxt is None else max(0.01, nxt - now)
+        return sorted(due), wait
+
+    def _recovery_loop(self) -> None:
+        # identity check: stop_recovery gives up joining after its
+        # timeout (a probe cannot be cancelled) and a later
+        # start_recovery spawns a fresh worker — a superseded thread
+        # must exit instead of double-probing
+        me = threading.current_thread()
+        while True:
+            with self._rec_cv:
+                if self._rec_stop or self._rec_thread is not me:
+                    return
+                due, wait = self._due_probes()
+                if not due:
+                    self._rec_cv.wait(wait)
+                    continue
+            for shard in due:
+                with self._rec_cv:
+                    if self._rec_stop or self._rec_thread is not me:
+                        return
+                self._probe_shard(shard)
+
+    def _default_canary(self, shard: int) -> bool:
+        """A tiny device computation on the probed chip — proves the
+        chip executes programs again. Placeholder devices (jax-free
+        meshes) pass trivially: there is no hardware to probe, and the
+        injected ``probe_fn`` is the scheduling-layer seam."""
+        if self.device_for(shard) is None:
+            return True
+        import jax
+        import jax.numpy as jnp
+
+        x = jnp.arange(8, dtype=jnp.int32)  # lands on the dispatch_to device
+        return int(jax.block_until_ready(x.sum())) == 28
+
+    def _rewarm_shard(self, shard: int) -> int:
+        """Best-effort: re-queue the compile plan's rungs for this
+        device. Rungs whose executables survived the loss are warm in
+        the registry and the worker skips them instantly — the
+        certified recovery pays ZERO fresh staged compiles; genuinely
+        cold rungs compile in the background and the per-shard routing
+        sheds around them meanwhile (a cold shard never stalls a
+        flush). Returns the number of rungs already warm."""
+        try:
+            from ...compile_service import service as _csvc
+
+            svc = _csvc.get_active_service()
+            if svc is None:
+                return 0
+            warm = len(svc.warm_rungs_active(device=shard))
+            for rung in svc.plan:
+                svc.request(*rung, device=shard)
+            return warm
+        except Exception:
+            return 0
+
+    def _resync_key_table(self, shard: int) -> None:
+        """Re-sync the device key table before re-admission (raises on
+        failure — a shard must never re-join with a replica behind the
+        host cache). The table mirrors every sync onto EVERY replica,
+        so one full catch-up sync covers whatever deltas failed while
+        the chip was down."""
+        try:
+            from . import key_table as _kt
+
+            tbl = _kt.get_table()
+        except Exception:
+            return
+        if tbl is None:
+            return
+        tbl.sync(reason="recovery")
+
+    def _probe_shard(self, shard: int) -> None:
+        t0 = time.monotonic()
+        err: Optional[BaseException] = None
+        ok = False
+        warm_rungs = 0
+        try:
+            # the probe runs inside the shard's dispatch scope so an
+            # injected probe_fn exercises the REAL per-shard seam (the
+            # canary lands on the probed chip, and chaos wrappers keyed
+            # on current_shard() see the probe)
+            with dispatch_to(shard):
+                probe = self._probe_fn or self._default_canary
+                ok = bool(probe(shard))
+            if ok:
+                warm_rungs = self._rewarm_shard(shard)
+                self._resync_key_table(shard)
+        except BaseException as e:  # noqa: BLE001 — a probe must never kill the worker
+            err, ok = e, False
+        if ok:
+            with self._lock:
+                st = self._shards.get(shard)
+                if st is None or not st.probation:
+                    return  # operator restored (or shard vanished) meanwhile
+                probes = st.probe_attempts + 1
+                down_s = t0 - (st.lost_at or t0)
+                st.recovered_total += 1
+                self._recoveries_total += 1
+            _SHARD_PROBES.with_labels(str(shard), "ok").inc()
+            _SHARD_RECOVERIES.with_labels(str(shard)).inc()
+            self.restore_shard(shard)
+            flight_recorder.record(
+                "shard_recovered",
+                shard=shard,
+                probes=probes,
+                down_s=round(down_s, 3),
+                warm_rungs=warm_rungs,
+                healthy_total=len(self.healthy_shards()),
+            )
+            from ...utils import logging as tlog
+
+            tlog.log(
+                "warn", "mesh shard recovered — re-admitted to the dp axis",
+                shard=shard, probes=probes, down_s=round(down_s, 3),
+            )
+        else:
+            with self._lock:
+                st = self._shards.get(shard)
+                if st is None or not st.probation:
+                    return
+                st.probe_attempts += 1
+                attempt = st.probe_attempts
+                delay = self._backoff(attempt + 1)
+                st.next_probe_t = time.monotonic() + delay
+            _SHARD_PROBES.with_labels(str(shard), "error").inc()
+            flight_recorder.record(
+                "shard_probation",
+                shard=shard,
+                attempt=attempt,
+                next_probe_s=round(delay, 3),
+                error=None if err is None else repr(err)[:200],
+            )
 
     # -- introspection ----------------------------------------------------
 
@@ -326,14 +671,19 @@ class DeviceMesh:
         # import surface minimal (both modules are jax-free).
         from ...utils import pipeline_profiler
 
+        mono_now = time.monotonic()
         with self._lock:
             chips = []
             agg_rate = 0.0
+            probation = []
+            recoveries = self._recoveries_total
             for i in sorted(self._shards):
                 st = self._shards[i]
                 rate = self._rate(st, now)
                 if st.healthy:
                     agg_rate += rate
+                if st.probation:
+                    probation.append(i)
                 dev = self.devices[i] if i < len(self.devices) else None
                 chips.append({
                     "shard": i,
@@ -347,12 +697,26 @@ class DeviceMesh:
                     "device_memory_bytes": mem.get(i),
                     "bubble_ratio": pipeline_profiler.shard_bubble_ratio(i),
                     "lost_error": st.lost_error,
+                    # probation/recovery (ISSUE 13)
+                    "probation": st.probation,
+                    "probe_attempts": st.probe_attempts,
+                    "next_probe_in_s": (
+                        round(max(0.0, st.next_probe_t - mono_now), 3)
+                        if st.probation and st.next_probe_t is not None
+                        else None
+                    ),
+                    "recovered_total": st.recovered_total,
                 })
             healthy = [i for i, s in self._shards.items() if s.healthy]
         return {
             "n_devices": len(self.devices),
             "healthy_shards": sorted(healthy),
             "lost_shards": sorted(set(self._shards) - set(healthy)),
+            "probation_shards": probation,
+            "recoveries_total": recoveries,
+            "recovery_running": self.recovery_running(),
+            "probe_base_s": self._probe_base_s,
+            "probe_max_s": self._probe_max_s,
             "aggregate_sets_per_sec": round(agg_rate, 2),
             "rate_window_s": _RATE_WINDOW_S,
             "chips": chips,

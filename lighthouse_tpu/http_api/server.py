@@ -454,6 +454,15 @@ class BeaconApiServer:
             from ..utils import pipeline_profiler
 
             doc["pipeline"] = pipeline_profiler.summary()
+            # fault injection (ISSUE 13): armed fault points + their
+            # call/injection counters — served ONLY while a chaos run
+            # is armed; a production node without chaos config shows
+            # null here (and pays one global check per fault seam)
+            from ..utils import fault_injection
+
+            doc["fault_injection"] = (
+                fault_injection.status() if fault_injection.armed() else None
+            )
             return {"data": doc}
         if path == "/lighthouse/flight_recorder":
             # live journal tail: ?kind=a,b filters, ?limit=N bounds the

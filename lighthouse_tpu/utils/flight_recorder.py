@@ -61,8 +61,10 @@ EVENT_KINDS = (
     "cold_route",             # compile_service/service.py, cold-bucket flush
     "compile_failed",         # compile_service/service.py, per failed rung
     "compile_ready",          # compile_service/service.py, rung now warm
+    "compile_retry",          # compile_service/service.py, failed rung re-queued
     "compile_started",        # compile_service/service.py, per AOT rung
     "deadline_miss",          # verification_service/batcher.py, SLO miss
+    "fault_injected",         # utils/fault_injection.py, one per injected fault
     "key_table_reset",        # crypto/device/key_table.py, agg region recycle
     "key_table_sync",         # crypto/device/key_table.py, startup/delta rows
     "log",                    # utils/logging.py, warn/error/crit lines
@@ -76,8 +78,11 @@ EVENT_KINDS = (
     "scheduler_shed",         # verification_service/batcher.py, backpressure
     "shard_dispatch",         # verification_service/batcher.py, dp sub-batch
     "shard_lost",             # crypto/device/mesh.py, chip dropped from axis
+    "shard_probation",        # crypto/device/mesh.py, probation entry/failed probe
+    "shard_recovered",        # crypto/device/mesh.py, chip re-admitted to axis
     "sync_rejected",          # beacon_chain/sync_committee_verification.py
     "transfer_ledger",        # utils/transfer_ledger.py, one per verify
+    "watchdog_reaped",        # verification_service/batcher.py, hung dispatch
 )
 _KINDS = frozenset(EVENT_KINDS)
 

@@ -83,6 +83,30 @@ gets its own backend dispatch, its own compile-service routing
 decision, and its own bisection scope; submissions stay atomic, so
 per-submission futures and verdict identity are untouched.
 
+Dispatch watchdog (ISSUE 13): a sharded sub-batch dispatch can HANG —
+a wedged device tunnel, a runaway injected stall — and before the
+watchdog that hang wedged the flush thread (or its dp worker) forever.
+With a deadline configured (``watchdog_s`` / env
+``LIGHTHOUSE_TPU_SCHED_WATCHDOG_S``), each sharded dispatch runs on a
+reaper-monitored thread: past the deadline the dispatch is abandoned
+(daemon thread; its eventual result is discarded) and converted into
+the EXISTING chip-loss failover path — the same sets re-verify on a
+failover shard, a success drops the hung shard into probation
+(``shard_lost`` → recovery, crypto/device/mesh.py) and verdict
+identity holds because the re-verify IS the verdict. A failover that
+also times out means the WORK hangs: the shard keeps its health and
+:class:`WatchdogTimeout` propagates like any backend raise. The
+deadline is OFF by default (0) — a cold dispatch legitimately blocks
+minutes on an XLA compile, so arming it is an operator decision (set
+it above the worst-case cold compile, or run a prebaked compile
+cache); the ``verify_now`` bypass has its own knob
+(``LIGHTHOUSE_TPU_SCHED_WATCHDOG_BYPASS_S``), also default off. Every
+reap ticks ``verification_scheduler_watchdog_reaped_total{shard}``
+and journals a ``watchdog_reaped`` event. The bypass additionally
+gains the failover contract (ISSUE 13 satellite): a failure during a
+``verify_now`` dispatch on the primary shard retries once on a
+failover shard instead of propagating into the block path.
+
 Cold-bucket protection (ISSUE 5): with a
 :class:`~lighthouse_tpu.compile_service.CompileService` attached, every
 flush (and every ``verify_now`` bypass) is routed first — a batch whose
@@ -268,6 +292,14 @@ _DP_SETS = metrics.counter_vec(
     "sets/s story into scheduler-side and device-side halves",
     ("shard",),
 )
+_WATCHDOG_REAPED = metrics.counter_vec(
+    "verification_scheduler_watchdog_reaped_total",
+    "sharded dispatches abandoned by the watchdog after exceeding the "
+    "configured deadline (each converts into the chip-loss failover "
+    "path: the same sets re-verify on a failover shard and the hung "
+    "chip enters probation — see the watchdog_reaped journal kind)",
+    ("shard",),
+)
 _DEADLINE_MISSES = metrics.counter_vec(
     "verification_scheduler_deadline_misses_total",
     "submissions whose verdict landed after the SLO budget (slo_grace x "
@@ -297,6 +329,12 @@ def _active_mesh():
         return None
 
 
+class WatchdogTimeout(RuntimeError):
+    """A sharded dispatch exceeded the watchdog deadline and was
+    abandoned — handled exactly like a raised dispatch (failover
+    decides whether the chip or the work is the problem)."""
+
+
 class _Submission:
     __slots__ = ("kind", "sets", "future", "submitted_at")
 
@@ -323,6 +361,8 @@ class VerificationScheduler:
         plan_flushes: bool | None = None,
         flush_planner=None,
         slo_grace: float | None = None,
+        watchdog_s: float | None = None,
+        watchdog_bypass_s: float | None = None,
     ):
         self._verify = verify_fn or bls.verify_signature_sets
         # warm-shape router (compile_service/service.py); None = every
@@ -364,6 +404,21 @@ class VerificationScheduler:
             if slo_grace is not None
             else _env_float("LIGHTHOUSE_TPU_SCHED_SLO_GRACE", 2.0),
         )
+        # dispatch watchdog deadlines (ISSUE 13; module docstring): 0 =
+        # off. Off by default — a cold dispatch legitimately blocks
+        # minutes on an XLA compile, so the deadline is an operator
+        # decision (bypass has its own knob, also default off)
+        self.watchdog_s = float(
+            watchdog_s
+            if watchdog_s is not None
+            else _env_float("LIGHTHOUSE_TPU_SCHED_WATCHDOG_S", 0.0)
+        )
+        self.watchdog_bypass_s = float(
+            watchdog_bypass_s
+            if watchdog_bypass_s is not None
+            else _env_float("LIGHTHOUSE_TPU_SCHED_WATCHDOG_BYPASS_S", 0.0)
+        )
+        self._watchdog_reaped = 0
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._pending: deque[_Submission] = deque()
@@ -525,8 +580,24 @@ class VerificationScheduler:
                 with transfer_ledger.context(kind, path):
                     if mesh is not None and primary is not None:
                         t_mesh = time.monotonic()
-                        with _mesh_module().dispatch_to(primary):
-                            out = self._verify(sets)
+                        try:
+                            out = self._dispatch_on(
+                                self._verify, sets, primary,
+                                self.watchdog_bypass_s,
+                            )
+                        except BaseException as e:  # noqa: BLE001
+                            # chip-loss failover on the bypass too
+                            # (ISSUE 13 satellite): one retry on a
+                            # failover shard — same verdict-identity
+                            # contract as sharded sub-batches — instead
+                            # of propagating into the block path. A
+                            # failover that raises the same way means
+                            # the WORK is the problem and the raise
+                            # reaches the caller (pre-mesh contract).
+                            return self._failover_retry(
+                                self._verify, sets, primary, e, mesh,
+                                watchdog_s=self.watchdog_bypass_s,
+                            )
                         mesh.note_dispatch(
                             primary, len(sets),
                             time.monotonic() - t_mesh,
@@ -889,6 +960,57 @@ class VerificationScheduler:
             )
         return {"ok": ok, "route": route_action, "paid": paid}
 
+    def _dispatch_on(self, verify, sets, shard, deadline_s: float):
+        """One dispatch scoped to ``shard``'s device — under the
+        watchdog when ``deadline_s`` > 0: the call runs on a monitored
+        daemon thread (which re-enters this thread's ledger/profiler
+        attribution scopes and the shard's dispatch scope, so
+        byte/phase attribution is unchanged) and a dispatch that blows
+        the deadline raises :class:`WatchdogTimeout` here — the caller
+        converts it into the chip-loss failover path instead of
+        wedging the flush thread on a hung device."""
+        mesh_mod = _mesh_module()
+        if not deadline_s or deadline_s <= 0:
+            with mesh_mod.dispatch_to(shard):
+                return verify(sets)
+        ctx = transfer_ledger.current_context()
+        rec = pipeline_profiler.current_flush()
+        box: dict = {}
+        done = threading.Event()
+
+        def target():
+            try:
+                with transfer_ledger.context(*ctx), \
+                        pipeline_profiler.flush_scope(rec), \
+                        mesh_mod.dispatch_to(shard):
+                    box["ok"] = verify(sets)
+            except BaseException as e:  # noqa: BLE001 — relayed below
+                box["err"] = e
+            finally:
+                done.set()
+
+        worker = threading.Thread(
+            target=target, name=f"dispatch-wd-{shard}", daemon=True
+        )
+        worker.start()
+        if not done.wait(deadline_s):
+            with self._lock:
+                self._watchdog_reaped += 1
+            _WATCHDOG_REAPED.with_labels(str(shard)).inc()
+            flight_recorder.record(
+                "watchdog_reaped",
+                shard=shard,
+                deadline_s=deadline_s,
+                n_sets=len(sets),
+            )
+            raise WatchdogTimeout(
+                f"sharded dispatch on shard {shard} exceeded the "
+                f"{deadline_s:g}s watchdog deadline"
+            )
+        if "err" in box:
+            raise box["err"]
+        return box["ok"]
+
     def _sharded_verify(self, verify, shard: int, mesh):
         """Wrap ``verify`` so the whole resolution tree of one sharded
         sub-batch dispatches on ``shard``'s device — and so LOSING that
@@ -901,8 +1023,11 @@ class VerificationScheduler:
         failover's, so verdict identity holds. A failover that raises
         the same way means the WORK is the problem: the shard keeps its
         health and the exception propagates exactly as the pre-mesh
-        contract demands (bisection delivers it leaf by leaf)."""
-        mesh_mod = _mesh_module()
+        contract demands (bisection delivers it leaf by leaf). A HUNG
+        dispatch is the same story through the watchdog (ISSUE 13):
+        past the deadline the dispatch raises :class:`WatchdogTimeout`
+        and takes this exact failover path instead of wedging the
+        flush thread."""
         state = {"failed_over": False}
 
         def run(sets):
@@ -913,8 +1038,9 @@ class VerificationScheduler:
                 return verify(sets)  # every chip lost: default device
             t0 = time.monotonic()
             try:
-                with mesh_mod.dispatch_to(target):
-                    out = verify(sets)
+                out = self._dispatch_on(
+                    verify, sets, target, self.watchdog_s
+                )
             except BaseException as e:  # noqa: BLE001 — failover decides
                 if target != shard:
                     raise  # the failover shard itself raised: real error
@@ -925,14 +1051,14 @@ class VerificationScheduler:
 
         return run
 
-    def _failover_retry(self, verify, sets, shard: int, err, mesh):
-        mesh_mod = _mesh_module()
+    def _failover_retry(self, verify, sets, shard: int, err, mesh,
+                        watchdog_s: float | None = None):
         fb = mesh.failover_shard(shard)
+        wd = self.watchdog_s if watchdog_s is None else watchdog_s
         t0 = time.monotonic()
         try:
             if fb is not None:
-                with mesh_mod.dispatch_to(fb):
-                    out = verify(sets)
+                out = self._dispatch_on(verify, sets, fb, wd)
             else:
                 out = verify(sets)
         except BaseException:
@@ -1092,6 +1218,9 @@ class VerificationScheduler:
             "fused_batches_total": self._fused_batches,
             "bisections_total": self._bisections,
             "shed_total": self._shed,
+            "watchdog_s": self.watchdog_s,
+            "watchdog_bypass_s": self.watchdog_bypass_s,
+            "watchdog_reaped_total": self._watchdog_reaped,
             "last_batch_occupancy": round(self._last_occupancy, 4),
             "buckets_seen": sorted(self._buckets_seen),
             "compile_service_attached": self._compile_service is not None,

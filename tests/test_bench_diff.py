@@ -18,7 +18,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _bench_doc(sets_per_sec, waste, wrapped=False, kt_bytes=45.0,
-               bubble=0.2):
+               bubble=0.2, recover_s=0.5):
     doc = {
         "metric": "bls_sigset_verifications_per_sec_per_chip",
         "value": sets_per_sec,
@@ -52,6 +52,13 @@ def _bench_doc(sets_per_sec, waste, wrapped=False, kt_bytes=45.0,
             "bubble_ratio": bubble,
             "flush_thread_saturation": 0.3,
             "overlap": {"projected_speedup": 1.2},
+        },
+        # ISSUE 13: the chaos leg's time-to-recover is gated (a slower
+        # recovery = leaked verify capacity)
+        "chaos_leg": {
+            "time_to_recover_s": recover_s,
+            "slo_miss_ratio_degraded": 0.0,
+            "post_recovery_sets_per_sec": 100.0,
         },
     }
     return {"n": 1, "rc": 0, "parsed": doc} if wrapped else doc
@@ -118,6 +125,16 @@ def test_diff_exits_nonzero_on_regression(tmp_path):
         bench_diff.load_bench(old), bench_diff.load_bench(pb_bad)
     )
     assert rep_pb["regressions"] == ["pipeline_bubble_ratio"]
+    # ISSUE 13 gate: time-to-recover growing >20% (the self-healing
+    # mesh restoring capacity slower) exits nonzero too
+    rc_bad = _write(
+        tmp_path, "g_rc.json", _bench_doc(10.0, 0.5, recover_s=2.0)
+    )
+    assert bench_diff.main([old, rc_bad]) == 1
+    rep_rc = bench_diff.diff(
+        bench_diff.load_bench(old), bench_diff.load_bench(rc_bad)
+    )
+    assert rep_rc["regressions"] == ["chaos_time_to_recover_s"]
     # a gate that cannot be evaluated is reported LOUDLY, not silently
     # dropped (exit stays 0 — absence of data is not a regression)
     legacy = dict(_bench_doc(10.0, 0.5))

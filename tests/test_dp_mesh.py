@@ -628,3 +628,37 @@ def test_traffic_replay_dp_kill_shard_cli():
     assert rep["verdicts"]["invalid"] == 0
     assert rep["mesh"]["lost_shards"] == [1]
     assert rep["mesh"]["healthy_shards"] == [0]
+
+
+def test_traffic_replay_revive_shard_cli():
+    """CLI e2e (ISSUE 13): --revive-shard drives kill -> probation ->
+    recovery mid-replay — the mesh ends fully healthy, every verdict
+    stays ok, and the report carries the recovery timeline
+    (time-to-recover, flushes served degraded, post-recovery sets/s)."""
+    import json
+
+    # kill arms after 3 backend calls and clears after 10 TOTAL calls
+    # (flush dispatches + failed probes both count), so with a 0.1 s
+    # probe base the recovery lands well inside the ~1.2 s replay wall
+    r = subprocess.run(
+        [sys.executable, os.path.join("tools", "traffic_replay.py"),
+         "--generate", "gossip_steady", "--seed", "5", "--duration", "6",
+         "--dp", "2", "--kill-shard", "1", "--kill-after", "3",
+         "--revive-shard", "1", "--revive-after", "10",
+         "--probe-base-s", "0.1",
+         "--verify", "stub:0.001", "--deadline-ms", "100",
+         "--time-scale", "0.2", "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=180,
+    )
+    assert r.returncode == 0, r.stderr
+    rep = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rep["verdicts"]["error"] == 0
+    assert rep["verdicts"]["invalid"] == 0
+    rec = rep["recovery"]
+    assert rec["lost"] and rec["recovered"], rec
+    assert rec["revived"] is True
+    assert rec["time_to_recover_s"] > 0
+    assert rec["probes"] >= 1
+    assert rec["flushes_served_degraded"] >= 1
+    assert rep["mesh"]["healthy_shards"] == [0, 1]
+    assert rep["mesh"]["recoveries_total"] == 1

@@ -1,0 +1,177 @@
+"""Deterministic fault-injection layer (ISSUE 13,
+utils/fault_injection.py): trigger shapes, sticky vs one-shot modes,
+hang actions, spec parsing, the journal/metric surface, the
+sub-microsecond disarmed path, and — the property the chaos gates
+lean on — schedule determinism (same seed ⇒ same injected-failure
+schedule), pinned in a jax-free subprocess."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from lighthouse_tpu.utils import fault_injection as fi
+from lighthouse_tpu.utils import flight_recorder, metrics
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    fi.clear()
+    yield
+    fi.clear()
+
+
+def _fire_n(point: str, n: int) -> list:
+    out = []
+    for _ in range(n):
+        try:
+            fi.fire(point)
+            out.append(False)
+        except fi.InjectedFault:
+            out.append(True)
+    return out
+
+
+def test_nth_is_one_shot():
+    fi.arm("staged_dispatch", nth=3)
+    assert _fire_n("staged_dispatch", 6) == [
+        False, False, True, False, False, False,
+    ]
+
+
+def test_every_k_and_sticky():
+    fi.arm("compile", every=3)
+    assert _fire_n("compile", 7) == [
+        False, False, True, False, False, True, False,
+    ]
+    fi.arm("compile", every=3, sticky=True)  # re-arm resets counters
+    assert _fire_n("compile", 7) == [
+        False, False, True, True, True, True, True,
+    ]
+
+
+def test_after_warmin_and_count_cap():
+    fi.arm("device_put", every=2, after=3, count=2)
+    # calls 1-3 are warm-in; schedule indices restart after them
+    assert _fire_n("device_put", 12) == [
+        False, False, False,          # warm-in
+        False, True, False, True,     # every=2 on post-warm-in indices
+        False, False, False, False, False,  # count cap reached
+    ]
+
+
+def test_hang_action_sleeps_instead_of_raising():
+    fi.arm("staged_dispatch", nth=1, hang_s=0.15)
+    t0 = time.perf_counter()
+    fi.fire("staged_dispatch")  # must NOT raise
+    assert time.perf_counter() - t0 >= 0.14
+    st = fi.status()
+    assert st["points"]["staged_dispatch"]["injected"] == 1
+
+
+def test_seeded_schedule_is_deterministic_and_seed_sensitive():
+    a = fi.schedule(64, p=0.3, seed=7)
+    b = fi.schedule(64, p=0.3, seed=7)
+    c = fi.schedule(64, p=0.3, seed=8)
+    assert a == b
+    assert a != c
+    assert any(a), "p=0.3 over 64 calls must fire at least once"
+    # the live fire() path follows the same pure schedule
+    fi.arm("compile", p=0.3, seed=7)
+    assert _fire_n("compile", 64) == a
+
+
+def test_spec_parse_roundtrip_and_malformed_rejected():
+    plan = fi.parse_spec(
+        "staged_dispatch:nth=2;compile:every=3,mode=sticky;"
+        "key_table_sync:hang=0.5,count=1"
+    )
+    assert plan["staged_dispatch"] == {"nth": 2}
+    assert plan["compile"] == {"every": 3, "sticky": True}
+    assert plan["key_table_sync"] == {"hang_s": 0.5, "count": 1}
+    with pytest.raises(ValueError):
+        fi.parse_spec("not_a_point:nth=1")
+    with pytest.raises(ValueError):
+        fi.parse_spec("compile:bogus_key=1")
+    with pytest.raises(ValueError):
+        fi.parse_spec("compile:mode=chaotic")
+    fi.configure("staged_dispatch:nth=1")
+    assert fi.armed()
+    assert _fire_n("staged_dispatch", 2) == [True, False]
+
+
+def test_journal_and_metrics_on_injection():
+    fam = metrics.get("fault_injections_total")
+    before = fam.with_labels("staged_dispatch", "raise").value
+    fi.arm("staged_dispatch", nth=1)
+    assert _fire_n("staged_dispatch", 1) == [True]
+    assert fam.with_labels("staged_dispatch", "raise").value == before + 1
+    if flight_recorder.enabled():
+        evs = flight_recorder.events(["fault_injected"])
+        assert evs and evs[-1]["fields"]["point"] == "staged_dispatch"
+        assert evs[-1]["fields"]["action"] == "raise"
+
+
+def test_clear_restores_disarmed_and_unknown_points_rejected():
+    fi.arm("compile", nth=1)
+    fi.clear("compile")
+    assert not fi.armed()
+    fi.fire("compile")  # disarmed: free no-op, never raises
+    with pytest.raises(ValueError):
+        fi.arm("bogus_point", nth=1)
+
+
+def test_disarmed_fire_costs_under_one_microsecond():
+    assert not fi.armed()
+    n = 20_000
+    fire = fi.fire
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            fire("staged_dispatch")
+        best = min(best, (time.perf_counter() - t0) / n)
+    assert best < 1e-6, (
+        f"disarmed fire() costs {best * 1e9:.0f} ns — too expensive to "
+        f"leave compiled into the staged dispatch hot path"
+    )
+
+
+def test_schedule_determinism_subprocess_jax_free():
+    """The chaos-run reproducibility contract: the same seed produces
+    the same injected-failure schedule in a FRESH process (no shared
+    state), and the module never pulls jax in."""
+    code = (
+        "import sys\n"
+        "from lighthouse_tpu.utils import fault_injection as fi\n"
+        "sched = fi.schedule(48, p=0.25, seed=11)\n"
+        "fi.arm('staged_dispatch', p=0.25, seed=11)\n"
+        "live = []\n"
+        "for _ in range(48):\n"
+        "    try:\n"
+        "        fi.fire('staged_dispatch')\n"
+        "        live.append(0)\n"
+        "    except fi.InjectedFault:\n"
+        "        live.append(1)\n"
+        "assert live == [int(x) for x in sched]\n"
+        "assert 'jax' not in sys.modules, 'fault layer must stay jax-free'\n"
+        "print(''.join(str(x) for x in live))\n"
+    )
+    runs = [
+        subprocess.run(
+            [sys.executable, "-c", code], cwd=REPO,
+            capture_output=True, text=True, timeout=120,
+        )
+        for _ in range(2)
+    ]
+    for r in runs:
+        assert r.returncode == 0, r.stderr
+    assert runs[0].stdout == runs[1].stdout, (
+        "same seed must reproduce the same schedule across processes"
+    )

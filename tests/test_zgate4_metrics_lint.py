@@ -34,6 +34,7 @@ KNOWN_PREFIXES = (
     "bls_device_",
     "compile_service_",
     "device_",  # device_memory_bytes (utils/transfer_ledger.py, ISSUE 8)
+    "fault_",  # fault-injection layer (utils/fault_injection.py, ISSUE 13)
     "flight_recorder_",
     "head_",
     "http_api_",
@@ -66,6 +67,7 @@ def _import_instrumented_modules():
     import lighthouse_tpu.crypto.device.key_table  # noqa: F401
     import lighthouse_tpu.crypto.device.mesh  # noqa: F401
     import lighthouse_tpu.http_api.server  # noqa: F401
+    import lighthouse_tpu.utils.fault_injection  # noqa: F401
     import lighthouse_tpu.utils.flight_recorder  # noqa: F401
     import lighthouse_tpu.utils.logging  # noqa: F401
     import lighthouse_tpu.utils.monitoring  # noqa: F401
@@ -327,6 +329,71 @@ def test_dp_mesh_families_registered():
          "with mesh.dispatch_to(0):\n"
          "    pass\n"
          "assert 'jax' not in sys.modules, 'mesh must stay jax-free'\n"],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr
+
+
+def test_robustness_families_registered():
+    """ISSUE 13 families (fault injection + self-healing mesh +
+    watchdog + compile retry + key-table re-sync) exist under their
+    declared types + labels, the fault-point catalogue stays sorted,
+    and the fault-injection module is importable jax-free with a
+    sub-microsecond disarmed fire() seam (subprocess-pinned here; the
+    full behavioral suite is tests/test_fault_injection.py)."""
+    _import_instrumented_modules()
+    reg = metrics.registry_snapshot()
+    want = {
+        "fault_injections_total": ("counter", ("point", "action")),
+        "fault_points_armed": ("gauge", None),
+        "bls_device_shard_probation": ("gauge", ("shard",)),
+        "bls_device_shard_probes_total": ("counter", ("shard", "outcome")),
+        "bls_device_shard_recoveries_total": ("counter", ("shard",)),
+        "verification_scheduler_watchdog_reaped_total": (
+            "counter", ("shard",),
+        ),
+        "compile_service_compile_retries_total": ("counter", None),
+        "bls_device_key_table_resyncs_total": ("counter", ("outcome",)),
+    }
+    for name, (kind, labels) in want.items():
+        m = reg.get(name)
+        assert m is not None, f"family {name} not registered"
+        assert m.kind == kind, (name, m.kind)
+        if labels is not None:
+            assert m.labelnames == labels, (name, m.labelnames)
+        else:
+            assert not hasattr(m, "labelnames"), name  # unlabeled family
+    from lighthouse_tpu.utils import fault_injection
+
+    # the fault-point catalogue is a registry like EVENT_KINDS: sorted,
+    # unique, snake_case, and fire()/arm() reject undeclared points
+    pts = fault_injection.FAULT_POINTS
+    assert list(pts) == sorted(pts) and len(set(pts)) == len(pts)
+    for p in pts:
+        assert _NAME.match(p), f"fault point not snake_case: {p!r}"
+    with pytest.raises(ValueError):
+        fault_injection.arm("zgate4_undeclared_point", nth=1)
+    # jax-free import + arm/fire round trip, subprocess-pinned (the
+    # mesh recovery worker and metrics lint import this module on
+    # boxes that must not initialize a backend)
+    import os
+    import subprocess
+    import sys
+
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import sys\n"
+         "from lighthouse_tpu.utils import fault_injection as fi\n"
+         "fi.arm('staged_dispatch', nth=1)\n"
+         "try:\n"
+         "    fi.fire('staged_dispatch')\n"
+         "    raise SystemExit('expected InjectedFault')\n"
+         "except fi.InjectedFault:\n"
+         "    pass\n"
+         "fi.clear()\n"
+         "fi.fire('staged_dispatch')  # disarmed: free no-op\n"
+         "assert 'jax' not in sys.modules, 'fault layer must stay jax-free'\n"],
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         capture_output=True, text=True, timeout=120,
     )

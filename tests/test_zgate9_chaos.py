@@ -1,0 +1,237 @@
+"""ISSUE 13 acceptance gate: the self-healing loop under deterministic
+chaos, end to end through scheduler → planner → compile-service
+routing → mesh recovery on a 2-shard virtual mesh (placeholder
+devices — the machinery under test is the scheduling/recovery layer;
+the staged-device half of degradation is tests/test_zgate8_multichip).
+
+Certifies, under a gossip-shaped fused load:
+
+* an injected STICKY dispatch fault (utils/fault_injection.py, the
+  ``staged_dispatch`` point keyed to shard 1's dispatch scope) drops
+  the shard — degraded serving continues and verdict identity holds
+  (a poisoned submission riding the degraded flush is still the ONLY
+  one rejected);
+* probation backoff is OBSERVED: repeated failed probes journal
+  ``shard_probation`` with growing attempt numbers;
+* after the fault clears, the shard is RE-ADMITTED — post-recovery
+  flushes dp-split across both shards again with ZERO fresh staged
+  compiles (the re-warm found every plan rung still warm in the
+  registry: the executables survived the loss) and no SLO misses
+  after re-admission;
+* a separately injected HANG (the ``hang=S`` fault action) is reaped
+  by the dispatch watchdog within its deadline instead of wedging the
+  flush thread, and resolves through failover with verdicts intact.
+
+Named ``test_zgate9_*`` so it tail-sorts with the other acceptance
+gates; unlike zgate8 it pays no XLA compiles (seconds, not minutes).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from lighthouse_tpu import compile_service as cs_mod
+from lighthouse_tpu.compile_service import CompileService
+from lighthouse_tpu.crypto.device import mesh as mesh_mod
+from lighthouse_tpu.utils import fault_injection as fi
+from lighthouse_tpu.utils import flight_recorder
+from lighthouse_tpu.verification_service import VerificationScheduler
+from lighthouse_tpu.verification_service.planner import FlushPlanner
+
+N_SUBS = 16  # 2 shards x 8 single-set submissions -> rung (8, 1, 1)
+# every set shares ONE message (below), so the only geometries traffic
+# can demand are the dp-split shape and the degraded single-shard
+# shape — warm both and any fresh compile is a real regression
+RUNGS = ((8, 1, 1), (16, 1, 1))
+
+
+def _mk_sets(kind, n):
+    return [(None, [None], b"zgate9-shared-message") for _ in range(n)]
+
+
+def _feed(sched, subs_sets, kind="unaggregated"):
+    futs = [None] * len(subs_sets)
+
+    def one(i):
+        futs[i] = sched.submit(subs_sets[i], kind)
+
+    threads = [
+        threading.Thread(target=one, args=(i,))
+        for i in range(len(subs_sets))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return [f.result(timeout=120) for f in futs]
+
+
+def _wait(cond, timeout=15.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def test_chaos_sticky_fault_probation_recovery_zero_fresh_compiles():
+    compile_calls = []
+
+    def compile_rung(b, k, m):
+        compile_calls.append((b, k, m))
+        return {
+            s: {"seconds": 0.001, "fresh": True}
+            for s in ("stage1", "stage2", "stage3")
+        }
+
+    poison = _mk_sets("p", 1)
+
+    def verify(sets):
+        if mesh_mod.current_shard() == 1:
+            # the chaos seam: while the sticky fault is armed, EVERY
+            # shard-1 dispatch (recovery probes included — they run
+            # under dispatch_to(1)) raises InjectedFault here
+            fi.fire("staged_dispatch")
+        return not any(s is poison[0] for s in sets)
+
+    mesh = mesh_mod.DeviceMesh(
+        devices=[None, None], probe_base_s=0.08, probe_max_s=0.4
+    )
+    mesh_mod.set_mesh(mesh)
+    # probe through the SAME verify seam traffic uses: a 1-set canary
+    # that fails while the fault is armed and passes once it clears
+    mesh.start_recovery(
+        probe_fn=lambda shard: bool(verify(_mk_sets("canary", 1)))
+    )
+    svc = CompileService(rungs=RUNGS, compile_rung_fn=compile_rung).start()
+    cs_mod.set_service(svc)
+    sched = VerificationScheduler(
+        verify_fn=verify, deadline_ms=10_000.0, max_batch_sets=N_SUBS,
+        compile_service=svc,
+        flush_planner=FlushPlanner(dp_min_sets=4),
+    ).start()
+    try:
+        # AOT walk: every rung warm on BOTH devices before traffic
+        _wait(
+            lambda: all(
+                len(svc.warm_rungs_active(device=d)) == len(RUNGS)
+                for d in (0, 1)
+            ),
+            msg="mesh ladder warm",
+        )
+        warm_compiles = len(compile_calls)
+        assert warm_compiles == len(RUNGS) * 2, compile_calls
+
+        # phase 1 — healthy: the fused flush dp-splits across both
+        # shards, warm-routed (no cold routes, no fresh compiles)
+        subs = [_mk_sets("u", 1) for _ in range(N_SUBS)]
+        assert all(_feed(sched, subs))
+        last = sched.status()["planner"]["last_plan"]
+        assert last["dp_shards"] == [0, 1], last
+        assert len(compile_calls) == warm_compiles
+
+        # phase 2 — arm the STICKY fault: shard 1 drops, serving
+        # continues degraded, and verdict identity holds (the poisoned
+        # submission is the only False)
+        fi.arm("staged_dispatch", nth=1, sticky=True)
+        results = _feed(sched, subs[: N_SUBS - 1] + [poison])
+        assert results[:-1] == [True] * (N_SUBS - 1)
+        assert results[-1] is False
+        assert mesh.healthy_shards() == [0]
+        assert mesh.is_probing(1)
+        if flight_recorder.enabled():
+            lost = flight_recorder.events(["shard_lost"])
+            assert lost and lost[-1]["fields"]["shard"] == 1
+
+        # probation BACKOFF observed: at least two failed probes, each
+        # journaled with a growing attempt number
+        _wait(
+            lambda: mesh.status()["chips"][1]["probe_attempts"] >= 2,
+            msg="backoff probes",
+        )
+        if flight_recorder.enabled():
+            attempts = [
+                e["fields"]["attempt"]
+                for e in flight_recorder.events(["shard_probation"])
+                if e["fields"]["shard"] == 1
+            ]
+            assert attempts[0] == 0 and max(attempts) >= 2, attempts
+
+        # degraded serving keeps working on the survivor meanwhile
+        assert all(_feed(sched, subs))
+        assert sched.status()["dp_shards"] == 1
+
+        # phase 3 — the fault clears: the next probe passes, the
+        # re-warm finds every plan rung still warm, the key table has
+        # nothing to catch up, and the shard is re-admitted
+        fi.clear()
+        _wait(lambda: mesh.healthy_shards() == [0, 1], msg="re-admission")
+        if flight_recorder.enabled():
+            recs = flight_recorder.events(["shard_recovered"])
+            assert recs and recs[-1]["fields"]["shard"] == 1
+            assert recs[-1]["fields"]["warm_rungs"] == len(RUNGS)
+
+        # phase 4 — post-recovery: flushes dp-split across BOTH shards
+        # again, with ZERO fresh staged compiles (the re-warm used the
+        # existing executables) and no SLO misses after re-admission
+        misses_before = sched.slo_summary()["deadline_misses_total"]
+        for _round in range(3):
+            assert all(_feed(sched, subs))
+        last = sched.status()["planner"]["last_plan"]
+        assert last["dp_shards"] == [0, 1], last
+        assert len(compile_calls) == warm_compiles, (
+            "post-recovery flushes must pay zero fresh staged compiles"
+        )
+        assert (
+            sched.slo_summary()["deadline_misses_total"] == misses_before
+        ), "no SLO misses after re-admission"
+        assert mesh.status()["recoveries_total"] == 1
+    finally:
+        fi.clear()
+        sched.stop()
+        svc.stop()
+        cs_mod.clear_service(svc)
+        mesh.stop_recovery()
+        mesh_mod.clear_mesh(mesh)
+
+
+def test_chaos_injected_hang_is_reaped_within_watchdog_deadline():
+    def verify(sets):
+        if mesh_mod.current_shard() == 1:
+            # one-shot hang fault: the first shard-1 dispatch stalls
+            # well past the watchdog deadline, then returns normally
+            fi.fire("staged_dispatch")
+        return True
+
+    mesh = mesh_mod.DeviceMesh(devices=[None, None])
+    mesh_mod.set_mesh(mesh)
+    sched = VerificationScheduler(
+        verify_fn=verify, deadline_ms=60_000.0, max_batch_sets=N_SUBS,
+        watchdog_s=0.4,
+        flush_planner=FlushPlanner(dp_min_sets=4),
+    ).start()
+    try:
+        fi.arm("staged_dispatch", nth=1, hang_s=3.0)
+        subs = [_mk_sets("u", 1) for _ in range(N_SUBS)]
+        t0 = time.perf_counter()
+        assert all(_feed(sched, subs)), "the hang must degrade, not reject"
+        wall = time.perf_counter() - t0
+        # reaped within the deadline (+ failover + margin), not the
+        # 3 s the hang would have wedged the flush thread for
+        assert wall < 2.0, f"flush thread wedged {wall:.2f}s"
+        assert mesh.healthy_shards() == [0]
+        assert sched.status()["watchdog_reaped_total"] >= 1
+        if flight_recorder.enabled():
+            reaps = flight_recorder.events(["watchdog_reaped"])
+            assert reaps and reaps[-1]["fields"]["shard"] == 1
+            hangs = [
+                e for e in flight_recorder.events(["fault_injected"])
+                if e["fields"]["action"] == "hang"
+            ]
+            assert hangs, "the injected stall must be journaled"
+    finally:
+        fi.clear()
+        sched.stop()
+        mesh_mod.clear_mesh(mesh)

@@ -78,17 +78,28 @@ METRICS = (
      ("pipeline_leg", "flush_thread_saturation"), None),
     ("pipeline_overlap_speedup",
      ("pipeline_leg", "overlap", "projected_speedup"), True),
+    # ISSUE 13: the chaos leg — injected shard loss + in-replay
+    # recovery. time-to-recover is gated (slower recovery = leaked
+    # verify capacity, the thing the self-healing mesh exists to
+    # restore); the degradation miss ratio and post-recovery sets/s
+    # ride along ungated
+    ("chaos_time_to_recover_s", ("chaos_leg", "time_to_recover_s"), False),
+    ("chaos_slo_miss_ratio_degraded",
+     ("chaos_leg", "slo_miss_ratio_degraded"), False),
+    ("chaos_post_recovery_sets_per_sec",
+     ("chaos_leg", "post_recovery_sets_per_sec"), True),
 )
 
 # the metrics whose regression exits nonzero (ISSUE 8 throughput/waste
 # gates + the ISSUE 10 key-table bytes gate + the ISSUE 11 dp gate +
-# the ISSUE 12 pipeline-bubble gate)
+# the ISSUE 12 pipeline-bubble gate + the ISSUE 13 recovery gate)
 GATED = (
     "headline_sets_per_sec",
     "headline_padding_waste",
     "key_table_pubkeys_bytes_per_set",
     "dp2_sets_per_sec",
     "pipeline_bubble_ratio",
+    "chaos_time_to_recover_s",
 )
 
 

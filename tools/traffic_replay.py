@@ -94,22 +94,30 @@ def wrap_slow_flush(verify, every: int, slow_s: float):
     return wrapped
 
 
-def wrap_kill_shard(verify, shard: int, after_calls: int):
+def wrap_kill_shard(verify, shard: int, after_calls: int,
+                    revive_after: int | None = None):
     """After ``after_calls`` backend calls, every dispatch that lands on
     mesh shard ``shard`` raises — the injected mid-replay chip loss
     (ISSUE 11). The scheduler's failover re-verifies the same sets on a
     surviving shard, journals ``shard_lost``, and subsequent plans drop
     the axis entry; verdicts stay identical because the re-verify IS
-    the verdict."""
+    the verdict. With ``revive_after`` (ISSUE 13) the fault CLEARS
+    after that many total backend calls — recovery probes (which route
+    through this same wrapper under ``dispatch_to(shard)``) then
+    succeed and the mesh's recovery worker drives
+    kill → probation → re-admission mid-replay."""
     from lighthouse_tpu.crypto.device import mesh as mesh_mod
 
     lock = threading.Lock()
-    state = {"calls": 0, "killed": 0}
+    state = {"calls": 0, "killed": 0, "revived": False}
 
     def wrapped(sets) -> bool:
         with lock:
             state["calls"] += 1
             armed = state["calls"] > after_calls
+            if revive_after is not None and state["calls"] > revive_after:
+                armed = False
+                state["revived"] = True
         if armed and mesh_mod.current_shard() == shard:
             with lock:
                 state["killed"] += 1
@@ -118,6 +126,80 @@ def wrap_kill_shard(verify, shard: int, after_calls: int):
 
     wrapped.kill_state = state
     return wrapped
+
+
+def make_probe(verify_fn, set_factory):
+    """The replay's recovery probe (ISSUE 13): a 1-set canary through
+    the SAME (possibly kill-wrapped) verify seam the replay dispatches
+    through — the mesh's recovery worker runs it under
+    ``dispatch_to(shard)``, so an armed kill wrapper fails the probe
+    and a cleared one passes it."""
+    canary = set_factory("canary", 1, 1, 1)
+
+    def probe(shard) -> bool:
+        return bool(verify_fn(canary))
+
+    return probe
+
+
+def recovery_timeline(shard: int, since_wall_t: float) -> dict | None:
+    """The kill→probation→recovery timeline from the flight recorder
+    (ISSUE 13): time-to-recover, probes, flushes/sets served degraded,
+    SLO misses during degradation and post-recovery throughput. None
+    when the journal is disabled."""
+    from lighthouse_tpu.utils import flight_recorder as fr
+
+    if not fr.enabled():
+        return None
+
+    def _mine(kinds, field="shard", want=shard):
+        return [
+            e for e in fr.events(kinds)
+            if e["t"] >= since_wall_t and e["fields"].get(field) == want
+        ]
+
+    lost = _mine(["shard_lost"])
+    if not lost:
+        return {"shard": shard, "lost": False}
+    t_lost = lost[0]["t"]
+    recovered = _mine(["shard_recovered"])
+    t_rec = recovered[0]["t"] if recovered else None
+    probes = _mine(["shard_probation"])
+    flushes = [
+        e for e in fr.events(["scheduler_flush"]) if e["t"] >= since_wall_t
+    ]
+    misses = [
+        e for e in fr.events(["deadline_miss"]) if e["t"] >= since_wall_t
+    ]
+    t_end = t_rec if t_rec is not None else float("inf")
+    degraded = [e for e in flushes if t_lost <= e["t"] <= t_end]
+    degraded_sets = sum(e["fields"].get("n_sets") or 0 for e in degraded)
+    degraded_misses = len([e for e in misses if t_lost <= e["t"] <= t_end])
+    out = {
+        "shard": shard,
+        "lost": True,
+        "recovered": t_rec is not None,
+        "time_to_recover_s": (
+            None if t_rec is None else round(t_rec - t_lost, 3)
+        ),
+        "probes": len(probes),
+        "flushes_served_degraded": len(degraded),
+        "sets_served_degraded": degraded_sets,
+        "slo_misses_degraded": degraded_misses,
+        "slo_miss_ratio_degraded": (
+            round(degraded_misses / degraded_sets, 4) if degraded_sets else 0.0
+        ),
+    }
+    if t_rec is not None:
+        post = [e for e in flushes if e["t"] > t_rec]
+        post_sets = sum(e["fields"].get("n_sets") or 0 for e in post)
+        post_wall = (max(e["t"] for e in post) - t_rec) if post else 0.0
+        out["post_recovery_flushes"] = len(post)
+        out["post_recovery_sets"] = post_sets
+        out["post_recovery_sets_per_sec"] = (
+            round(post_sets / post_wall, 2) if post_wall > 0 else None
+        )
+    return out
 
 
 def make_crypto_set_factory():
@@ -467,6 +549,22 @@ def _print_human(header, report):
         f"(dispatch lag p50={lag['p50']} p99={lag['p99']} "
         f"max={lag['max']} ms)"
     )
+    rec = report.get("recovery")
+    if rec:
+        if rec.get("recovered"):
+            print(
+                f"  recovery: shard {rec['shard']} lost -> re-admitted in "
+                f"{rec['time_to_recover_s']}s ({rec['probes']} probes); "
+                f"{rec['flushes_served_degraded']} flushes "
+                f"({rec['sets_served_degraded']} sets) served degraded, "
+                f"miss ratio {rec['slo_miss_ratio_degraded']}; "
+                f"post-recovery {rec.get('post_recovery_sets_per_sec')} sets/s"
+            )
+        elif rec.get("lost"):
+            print(
+                f"  recovery: shard {rec['shard']} lost, NOT recovered "
+                f"({rec['probes']} probes)"
+            )
     print(f"  {'kind':<18}{'count':>7}{'p50_ms':>9}{'p99_ms':>9}"
           f"{'miss%':>7}  paths")
     for kind, rec in slo["kinds"].items():
@@ -547,6 +645,32 @@ def main(argv=None) -> int:
         "of the trace's events; 0 = from the first dispatch)",
     )
     run.add_argument(
+        "--revive-shard", type=int, default=None,
+        help="companion to --kill-shard (ISSUE 13): start the mesh "
+        "recovery worker and CLEAR the injected fault after "
+        "--revive-after backend calls, driving kill -> probation -> "
+        "re-admission mid-replay; the report gains a recovery "
+        "timeline (must equal --kill-shard)",
+    )
+    run.add_argument(
+        "--revive-after", type=int, default=None,
+        help="total backend calls after which the injected chip loss "
+        "clears (default: two thirds of the trace's events)",
+    )
+    run.add_argument(
+        "--probe-base-s", type=float, default=0.25,
+        help="recovery probe base backoff for --revive-shard "
+        "(capped exponential + jitter; default 0.25)",
+    )
+    run.add_argument(
+        "--fault", default=None,
+        help="arm the deterministic fault-injection layer "
+        "(utils/fault_injection.py) with a spec string, e.g. "
+        "'staged_dispatch:nth=5' or 'compile:every=2,mode=sticky' — "
+        "stub/native backends fire the staged_dispatch point once per "
+        "backend call; the device backend fires the real seams",
+    )
+    run.add_argument(
         "--no-planner", action="store_true",
         help="pin the legacy single-rung flush (every device flush "
         "resolves on the `fused` path)",
@@ -598,6 +722,23 @@ def main(argv=None) -> int:
         report["n_sets"] = sum(report["set_totals"].values())
     else:
         verify_fn, backend_name, set_factory = resolve_verify(args.verify)
+        fault_armed = False
+        if args.fault:
+            from lighthouse_tpu.utils import fault_injection
+
+            fault_injection.configure(args.fault)
+            fault_armed = True
+            if args.verify != "device":
+                # stub/native backends never reach the real device
+                # seams: fire the staged_dispatch point once per
+                # backend call so scripted chaos schedules apply
+                inner_verify = verify_fn
+
+                def faulted(sets) -> bool:
+                    fault_injection.fire("staged_dispatch")
+                    return inner_verify(sets)
+
+                verify_fn = faulted
         if args.slow_flush_every:
             verify_fn = wrap_slow_flush(
                 verify_fn, args.slow_flush_every,
@@ -623,6 +764,9 @@ def main(argv=None) -> int:
                 # backends measure is scheduling parallelism
                 dmesh = mesh_mod.DeviceMesh(devices=[None] * args.dp)
             mesh_mod.set_mesh(dmesh)
+        if args.revive_shard is not None:
+            if args.kill_shard is None or args.revive_shard != args.kill_shard:
+                raise SystemExit("--revive-shard must equal --kill-shard")
         if args.kill_shard is not None:
             if dmesh is None:
                 raise SystemExit("--kill-shard needs --dp > 1")
@@ -633,7 +777,26 @@ def main(argv=None) -> int:
                     if args.kill_after is not None
                     else max(1, len(events) // 3)
                 ),
+                revive_after=(
+                    None
+                    if args.revive_shard is None
+                    else (
+                        args.revive_after
+                        if args.revive_after is not None
+                        else max(2, (2 * len(events)) // 3)
+                    )
+                ),
             )
+        if args.revive_shard is not None:
+            # the recovery worker probes through the SAME kill-wrapped
+            # verify seam, so probes fail while the fault is armed and
+            # pass once it clears — the full kill->probation->recovery
+            # loop, in-replay (ISSUE 13)
+            dmesh.start_recovery(
+                probe_fn=make_probe(verify_fn, set_factory),
+                base_backoff_s=args.probe_base_s,
+            )
+        t_wall_start = time.time()
         try:
             report = run_timed_replay(
                 events,
@@ -651,8 +814,28 @@ def main(argv=None) -> int:
             if dmesh is not None:
                 from lighthouse_tpu.crypto.device import mesh as mesh_mod
 
+                dmesh.stop_recovery()
                 mesh_mod.clear_mesh(dmesh)
+            if fault_armed:
+                from lighthouse_tpu.utils import fault_injection
+
+                report_fault = fault_injection.status()
+                fault_injection.clear()
+            else:
+                report_fault = None
         report["mesh"] = None if dmesh is None else dmesh.status()
+        report["fault_injection"] = report_fault
+        if args.kill_shard is not None:
+            report["recovery"] = recovery_timeline(
+                args.kill_shard, t_wall_start
+            )
+            kill_state = getattr(verify_fn, "kill_state", None)
+            if kill_state is not None:
+                report["recovery"] = {
+                    **(report["recovery"] or {}),
+                    "killed_calls": kill_state["killed"],
+                    "revived": kill_state["revived"],
+                }
         report["trace"] = {
             k: header.get(k) for k in ("name", "seed", "n_events")
         }
