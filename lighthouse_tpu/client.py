@@ -97,12 +97,23 @@ class Client:
     def start(self):
         if self.api is not None:
             self.api.start()
+        # capacity & saturation observability (ISSUE 14): the background
+        # sampler that feeds /lighthouse/timeseries and the headroom
+        # estimate in the health `capacity` block. No-op (free) when
+        # LIGHTHOUSE_TPU_TIMESERIES=0.
+        from .utils import timeseries
+
+        if timeseries.enabled():
+            timeseries.start_sampler()
         self._timer.start()
         return self
 
     def stop(self):
         try:
             self._stop.set()
+            from .utils import timeseries
+
+            timeseries.stop_sampler()
             if self.api is not None:
                 self.api.stop()
             monitor = getattr(self.chain, "validator_monitor", None)

@@ -164,6 +164,17 @@ _FALLBACK_SECONDS = metrics.histogram(
     "resolution path (verification_scheduler_verdict_latency_seconds"
     "{path=fallback}) while the cold rung compiles behind it",
 )
+_MEASURED_COST = metrics.gauge(
+    "compile_service_measured_cost_seconds_per_set",
+    "organically measured WARM serving cost per signature set: "
+    "cumulative staged-verify wall / cumulative sets across every rung "
+    "note_rung_verified reported, EXCLUDING each rung's first dispatch "
+    "(whose wall includes the XLA compile — one cold compile must not "
+    "read the capacity dial as saturated for thousands of sets). The "
+    "rung-cost feed the capacity/headroom estimator reads when no "
+    "per-shard mesh walls exist (ISSUE 14); per-rung splits incl. "
+    "first dispatches in status()['rung_costs'] / measured_rung_costs()",
+)
 
 
 def _env_rungs() -> Optional[Tuple[Rung, ...]]:
@@ -325,6 +336,13 @@ class CompileService:
         self._attempts: dict = {}   # (rung, device) -> failures so far
         self._retry_at: dict = {}   # (rung, device) -> due monotonic time
         self._retries_total = 0
+        # rung-cost feed (ISSUE 14): measured verify cost from
+        # note_rung_verified — bounded by ladder size x mesh width (the
+        # registry only ever sees padded ladder rungs)
+        # (rung, device) -> [dispatches, sum_s, sum_sets]
+        self._rung_costs: dict = {}
+        self._cost_sum_s = 0.0
+        self._cost_sum_sets = 0
 
     # -- lifecycle --------------------------------------------------------
 
@@ -663,7 +681,8 @@ class CompileService:
 
     def note_rung_verified(
         self, b: int, k: int, m: int, epoch: int | None = None,
-        device: int = 0,
+        device: int = 0, seconds: float | None = None,
+        n_sets: int | None = None,
     ) -> None:
         """Organic warmth: a staged verify at (b, k, m) just succeeded on
         the dispatch path — on mesh ``device`` — so its three programs
@@ -671,8 +690,45 @@ class CompileService:
         touching the rung. ``epoch`` is the registry epoch the caller
         captured BEFORE dispatching: a verify racing
         ``device.reset_compiled_state()`` must not resurrect a rung
-        whose jit caches were just dropped."""
+        whose jit caches were just dropped.
+
+        ``seconds``/``n_sets`` (ISSUE 14) is the rung-cost feed: the
+        dispatcher reports the verify's full serving wall (pack +
+        staged dispatch) and live set count, accumulated per rung and
+        mirrored into ``compile_service_measured_cost_seconds_per_set``
+        — the cost input the capacity/headroom estimator
+        (``utils/timeseries.py``) falls back to when no per-shard mesh
+        walls exist. First-sighting walls include the XLA compile, so
+        the per-rung record keeps the dispatch count: a cost dominated
+        by one compiled dispatch washes out as the rung serves."""
         rung = (int(b), int(k), int(m))
+        if seconds is not None and n_sets:
+            with self._cv:
+                # keyed per (rung, DEVICE): compiles are per chip, so a
+                # failover re-verify on a shard where the rung is still
+                # cold pays the compile again — its wall must be
+                # excluded exactly like device 0's first sighting was
+                rec = self._rung_costs.setdefault(
+                    (rung, int(device)), [0, 0.0, 0]
+                )
+                warm = rec[0] > 0
+                rec[0] += 1
+                rec[1] += float(seconds)
+                rec[2] += int(n_sets)
+                # the GAUGE excludes each (rung, device)'s FIRST
+                # dispatch: its wall includes the XLA compile (~minutes
+                # over a few sets), and a cumulative average would read
+                # the capacity dial as saturated for thousands of sets
+                # after one cold compile. The per-rung record keeps
+                # every dispatch (the compile cost is real and
+                # reportable); only the serving-cost feed is warm-only.
+                if warm:
+                    self._cost_sum_s += float(seconds)
+                    self._cost_sum_sets += int(n_sets)
+                    if self._cost_sum_sets:
+                        _MEASURED_COST.set(
+                            self._cost_sum_s / self._cost_sum_sets
+                        )
         impl = self._impl()
         if self.registry.mark_ready(rung, impl, epoch=epoch, device=device):
             # persisted=False: the compile happened inside the verify,
@@ -684,6 +740,35 @@ class CompileService:
                 rung, impl, seconds=None, source="organic",
                 persisted=False, device=device,
             )
+
+    def measured_rung_costs(self) -> dict:
+        """Per-(rung, device) measured serving cost (the ISSUE 14
+        rung-cost feed): ``"BxKxM@devD" -> {dispatches, sum_s,
+        sum_sets, s_per_set}`` (ALL dispatches, first-sighting compile
+        walls included) plus the aggregate warm-only ``s_per_set`` the
+        estimator reads via the
+        ``compile_service_measured_cost_seconds_per_set`` gauge (each
+        (rung, device)'s first dispatch excluded — see the gauge
+        help)."""
+        with self._cv:
+            rungs = {
+                "x".join(str(v) for v in rung) + f"@dev{dev}": {
+                    "dispatches": n,
+                    "sum_s": round(s, 6),
+                    "sum_sets": sets,
+                    "s_per_set": round(s / sets, 9) if sets else None,
+                }
+                for (rung, dev), (n, s, sets)
+                in sorted(self._rung_costs.items())
+            }
+            total_s, total_sets = self._cost_sum_s, self._cost_sum_sets
+        return {
+            "rungs": rungs,
+            "s_per_set": (
+                round(total_s / total_sets, 9) if total_sets else None
+            ),
+            "sum_sets": total_sets,
+        }
 
     def _cache_files(self) -> Optional[set]:
         """Executable entries currently in the cache dir (None when no
@@ -990,6 +1075,8 @@ class CompileService:
                 "pending": retry_pending,
             },
             "cache": {**self.cache_status, "prebaked_rungs": [list(r) for r in prebaked]},
+            # the ISSUE 14 rung-cost feed the capacity estimator reads
+            "rung_costs": self.measured_rung_costs(),
         }
         if multi:
             doc["mesh_devices"] = list(devices)

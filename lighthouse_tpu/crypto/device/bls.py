@@ -1213,6 +1213,7 @@ class TpuBackend:
             # committed by the dispatcher, in one place, once the batch
             # is definitely taking the indexed path
             table.count_shipped(len(sets) - n_collapsed, n_collapsed)
+        t_serve0 = time.perf_counter()  # rung-cost feed (ISSUE 14)
         with tracing.span(
             "bls.verify_signature_sets", path=path, n_sets=len(sets)
         ) as sp, _VERIFY_SECONDS.with_labels(path, impl).time():
@@ -1256,6 +1257,11 @@ class TpuBackend:
                 int(args[4].shape[0]),    # M (msg_u)
                 epoch=warm_epoch,
                 device=shard,
+                # the rung-cost feed (ISSUE 14): full serving wall
+                # (pack + staged dispatch) per live set — the capacity
+                # estimator's fallback cost input
+                seconds=time.perf_counter() - t_serve0,
+                n_sets=len(sets),
             )
         _OUTCOMES.with_labels("ok" if out else "fail").inc()
         return out

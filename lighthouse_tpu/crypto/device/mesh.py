@@ -806,3 +806,18 @@ def get_active_mesh() -> Optional[DeviceMesh]:
     """The attached mesh; None when nothing is attached (single-device
     behavior everywhere)."""
     return _mesh
+
+
+def healthy_shard_count() -> int:
+    """Healthy shards the attached mesh is serving on right now — the
+    shard-count feed for the capacity/headroom estimator (ISSUE 14,
+    ``utils/timeseries.py``): read live, not from the dp gauge, which
+    only updates at flush time and would lag a chip loss. 0 when no
+    mesh is attached (the estimator treats that as single-device)."""
+    mesh = _mesh
+    if mesh is None:
+        return 0
+    try:
+        return len(mesh.healthy_shards())
+    except Exception:
+        return 0

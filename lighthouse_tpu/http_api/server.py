@@ -45,6 +45,7 @@ consume):
     GET  /eth/v1/beacon/deposit_snapshot
     GET  /eth/v1/debug/beacon/heads
     GET  /lighthouse/health
+    GET  /lighthouse/timeseries (?family=&window=&tier= filters)
     GET  /metrics
 """
 
@@ -463,6 +464,14 @@ class BeaconApiServer:
             doc["fault_injection"] = (
                 fault_injection.status() if fault_injection.armed() else None
             )
+            # capacity & saturation (ISSUE 14): the timeseries sampler's
+            # state + memory accounting, the sampled family catalogue
+            # and the latest capacity/headroom estimate — the dial
+            # ROADMAP item 2's admission control will read; history at
+            # /lighthouse/timeseries, rendered by tools/capacity_report.py
+            from ..utils import timeseries
+
+            doc["capacity"] = timeseries.capacity_summary()
             return {"data": doc}
         if path == "/lighthouse/flight_recorder":
             # live journal tail: ?kind=a,b filters, ?limit=N bounds the
@@ -482,6 +491,39 @@ class BeaconApiServer:
                     "events": flight_recorder.events(kinds=kinds, limit=limit),
                 }
             }
+        if path == "/lighthouse/timeseries":
+            # retained on-node metrics history (ISSUE 14): ?family=a,b
+            # filters to those series families, ?tier=raw|1m|10m picks
+            # the downsampling tier, ?window=SECONDS keeps only points
+            # newer than now − window. The latest capacity estimate
+            # rides along so one fetch answers "how much headroom, and
+            # which way is it trending".
+            from ..utils import timeseries
+
+            families = None
+            if "family" in query:
+                families = [f for f in query["family"].split(",") if f]
+            tier = query.get("tier", "raw")
+            window_s = None
+            if "window" in query:
+                try:
+                    window_s = float(query["window"])
+                except ValueError:
+                    raise ApiError(400, "malformed window parameter")
+                # nan compares False against every timestamp (silently
+                # empty series), negative/inf windows are nonsense —
+                # all are 400s per the documented grammar
+                if window_s != window_s or window_s < 0 \
+                        or window_s == float("inf"):
+                    raise ApiError(400, "malformed window parameter")
+            try:
+                doc = timeseries.get_store().doc(
+                    families=families, tier=tier, window_s=window_s
+                )
+            except ValueError as e:
+                raise ApiError(400, str(e))
+            doc["estimate"] = timeseries.last_estimate()
+            return {"data": doc}
 
 
         m = re.fullmatch(r"/eth/v1/beacon/states/([^/]+)/root", path)

@@ -80,6 +80,7 @@ EVENT_KINDS = (
     "shard_lost",             # crypto/device/mesh.py, chip dropped from axis
     "shard_probation",        # crypto/device/mesh.py, probation entry/failed probe
     "shard_recovered",        # crypto/device/mesh.py, chip re-admitted to axis
+    "slo_burn",               # verification_service/slo.py, budget burn alert
     "sync_rejected",          # beacon_chain/sync_committee_verification.py
     "transfer_ledger",        # utils/transfer_ledger.py, one per verify
     "watchdog_reaped",        # verification_service/batcher.py, hung dispatch

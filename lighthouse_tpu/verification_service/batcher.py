@@ -300,6 +300,18 @@ _WATCHDOG_REAPED = metrics.counter_vec(
     "chip enters probation — see the watchdog_reaped journal kind)",
     ("shard",),
 )
+_ARRIVALS = metrics.counter_vec(
+    "verification_scheduler_arrival_sets_total",
+    "signature sets ARRIVING at the scheduler per caller kind and entry "
+    "path (submit = the fusing queue, incl. submissions later shed; "
+    "bypass = verify_now), counted at submission time — NOT at flush "
+    "time like verification_scheduler_sets_total, whose rate saturates "
+    "at serving capacity exactly when the arrival rate matters most. "
+    "The capacity sampler (utils/timeseries.py) rates this family into "
+    "capacity_arrival_sets_per_sec, the utilization numerator "
+    "(ISSUE 14)",
+    ("kind", "path"),
+)
 _DEADLINE_MISSES = metrics.counter_vec(
     "verification_scheduler_deadline_misses_total",
     "submissions whose verdict landed after the SLO budget (slo_grace x "
@@ -485,6 +497,12 @@ class VerificationScheduler:
             # fused batch where it would have no sets to vote with
             self._finish(sub, False, path="empty")
             return sub.future
+        # arrival accounting (ISSUE 14): counted at SUBMISSION time —
+        # shed submissions included (they arrived; the queue just could
+        # not hold them) — so the capacity estimator's utilization
+        # numerator keeps climbing past saturation instead of reading
+        # serving throughput back as demand
+        _ARRIVALS.with_labels(kind, "submit").inc(len(sub.sets))
         shed = False
         with self._cv:
             if self._stopped:
@@ -545,6 +563,8 @@ class VerificationScheduler:
         traffic skips the fusing queue."""
         sets = list(sets)
         _BYPASS.with_labels(kind).inc()
+        if sets:
+            _ARRIVALS.with_labels(kind, "bypass").inc(len(sets))
         t0 = time.monotonic()
         path = "bypass"
         try:

@@ -12,18 +12,64 @@ rolling p50/p99 and the deadline-miss ratio over that window — the
 ``slo`` block the health endpoint serves and the traffic-replay harness
 (``tools/traffic_replay.py``, docs/TRAFFIC_REPLAY.md) certifies against.
 
+**Burn-rate tracking (ISSUE 14).** A window miss RATIO says what just
+happened; it does not say whether the miss BUDGET will survive the
+hour. The tracker therefore also keeps per-sample timestamps and
+answers the SRE-standard multi-window question: over the ``fast``
+window (default 60 s) and the ``slow`` window (default 600 s), at what
+multiple of the budgeted miss ratio (default 1%) are misses being
+consumed?  ``burn = window_miss_ratio / budget_miss_ratio`` — burn 1.0
+consumes exactly the budget over that window, burn 14 exhausts an hour
+of budget in ~4 minutes. When BOTH windows burn at or above the alert
+threshold (default 1.0 — "on track to exhaust"; the two-window AND
+suppresses blips the slow window forgives) the tracker journals ONE
+``slo_burn`` flight-recorder event per excursion (a continuing storm
+re-confirms the latch without re-firing; a stretch longer than the
+fast window without a CONFIRMED alert — quiet or merely sub-budget —
+re-arms it), ticks
+``verification_scheduler_slo_burn_events_total{kind}`` and serves the
+live burn rates in ``verification_scheduler_slo_burn_rate{kind,window}``
+— the standing alert primitive the capacity/headroom estimator
+(``utils/timeseries.py``) and ROADMAP item 2's admission control build
+on.
+
+**Ratio-scope fix (ISSUE 14 satellite).** ``misses_total`` /
+``count_total`` are LIFETIME counters and the window numbers are
+window-scoped — after long uptimes the two diverge, and a reader mixing
+a lifetime numerator with a windowed denominator gets a meaningless
+ratio. ``summary()`` now reports both scopes explicitly:
+``window_miss_ratio`` (window misses / window count, as before) AND
+``lifetime_miss_ratio`` (lifetime misses / lifetime count), so no
+consumer has to derive a ratio across scopes.
+
 Deliberately **jax-free** and scheduler-instance-scoped: a replay run or
-a test reads ITS scheduler's window, not the process-global metric
-registry another run already polluted.
+a test reads ITS scheduler's window (``summary()``/``burn()``), not the
+process-global metric registry another run already polluted. The burn
+GAUGE/counter families are the usual exception — like every scheduler
+metric family they are process-global, so concurrent trackers in one
+process share them (tests and dashboards read the per-instance
+documents for isolation).
 
 Design constraints (same discipline as the metric families):
 
-* ``observe()`` is O(1): one deque append under one lock — it sits on
-  every future resolution, including the shed path that runs in a
-  gossip caller's thread.
+* ``observe()`` is O(1) amortized: one deque append + one time-bucket
+  update under one lock — it sits on every future resolution,
+  including the shed path that runs in a gossip caller's thread. Burn
+  recomputation is miss-driven: a miss while UN-latched scans the
+  bounded bucket ring (≤ one slow window of buckets — so even the
+  first miss of a sub-millisecond burst is evaluated, never dropped by
+  a throttle), while misses inside a live excursion just refresh the
+  latch in O(1) — a sustained storm cannot turn the tracker into a
+  CPU sink.
 * ``summary()`` sorts only the bounded window (default 1024 samples per
   kind, ``LIGHTHOUSE_TPU_SLO_WINDOW``) — a health scrape can never walk
   unbounded history.
+
+Env knobs: ``LIGHTHOUSE_TPU_SLO_WINDOW`` (sample window),
+``LIGHTHOUSE_TPU_SLO_BUDGET_RATIO`` (budgeted miss ratio, default 0.01),
+``LIGHTHOUSE_TPU_SLO_FAST_S`` / ``LIGHTHOUSE_TPU_SLO_SLOW_S`` (burn
+windows, default 60/600 s), ``LIGHTHOUSE_TPU_SLO_BURN_ALERT`` (alert
+threshold, default 1.0).
 """
 
 from __future__ import annotations
@@ -31,14 +77,47 @@ from __future__ import annotations
 import math
 import os
 import threading
+import time
 from collections import deque
-from typing import Deque, Dict, Tuple
+from typing import Deque, Dict, Optional, Tuple
+
+from ..utils import flight_recorder, metrics
 
 DEFAULT_WINDOW = 1024
-_ENV_WINDOW = "LIGHTHOUSE_TPU_SLO_WINDOW"
+DEFAULT_BUDGET_MISS_RATIO = 0.01
+DEFAULT_FAST_WINDOW_S = 60.0
+DEFAULT_SLOW_WINDOW_S = 600.0
+DEFAULT_BURN_ALERT = 1.0
 
-# (latency_seconds, path, missed)
-_Sample = Tuple[float, str, bool]
+_ENV_WINDOW = "LIGHTHOUSE_TPU_SLO_WINDOW"
+_ENV_BUDGET = "LIGHTHOUSE_TPU_SLO_BUDGET_RATIO"
+_ENV_FAST = "LIGHTHOUSE_TPU_SLO_FAST_S"
+_ENV_SLOW = "LIGHTHOUSE_TPU_SLO_SLOW_S"
+_ENV_ALERT = "LIGHTHOUSE_TPU_SLO_BURN_ALERT"
+
+# (t, latency_seconds, path, missed)
+_Sample = Tuple[float, float, str, bool]
+
+_BURN_RATE = metrics.gauge_vec(
+    "verification_scheduler_slo_burn_rate",
+    "miss-budget burn rate per caller kind and window (fast/slow): "
+    "window miss ratio / budgeted miss ratio. 1.0 consumes exactly the "
+    "budget over that window; both windows >= the alert threshold "
+    "journals an slo_burn event (the standing alert primitive, "
+    "ISSUE 14). Updated on misses and on burn()/summary() reads",
+    ("kind", "window"),
+)
+_BURN_EVENTS = metrics.counter_vec(
+    "verification_scheduler_slo_burn_events_total",
+    "slo_burn alerts journaled per caller kind: both burn windows "
+    "crossed the alert threshold (latched — one event per excursion, "
+    "not per miss)",
+    ("kind",),
+)
+
+
+# one env-parsing convention across the observability knobs
+_env_float = flight_recorder._env_float
 
 
 def quantile_ms(sorted_latencies, q: float) -> float:
@@ -60,36 +139,248 @@ def quantile_ms(sorted_latencies, q: float) -> float:
 class SloTracker:
     """Bounded rolling window of verdict latencies per caller kind (see
     module docstring). ``observe`` is called by the scheduler on every
-    resolution; ``summary`` is the health-endpoint/replay-report read."""
+    resolution; ``summary`` is the health-endpoint/replay-report read;
+    ``burn`` is the miss-budget burn-rate read."""
 
-    def __init__(self, window: int | None = None):
+    def __init__(
+        self,
+        window: int | None = None,
+        budget_miss_ratio: float | None = None,
+        fast_window_s: float | None = None,
+        slow_window_s: float | None = None,
+        burn_alert: float | None = None,
+    ):
         if window is None:
             try:
                 window = int(os.environ.get(_ENV_WINDOW, ""))
             except ValueError:
                 window = DEFAULT_WINDOW
         self.window = max(1, int(window))
+        self.budget_miss_ratio = max(1e-9, float(
+            budget_miss_ratio
+            if budget_miss_ratio is not None
+            else _env_float(_ENV_BUDGET, DEFAULT_BUDGET_MISS_RATIO)
+        ))
+        self.fast_window_s = max(1e-3, float(
+            fast_window_s
+            if fast_window_s is not None
+            else _env_float(_ENV_FAST, DEFAULT_FAST_WINDOW_S)
+        ))
+        self.slow_window_s = max(self.fast_window_s, float(
+            slow_window_s
+            if slow_window_s is not None
+            else _env_float(_ENV_SLOW, DEFAULT_SLOW_WINDOW_S)
+        ))
+        self.burn_alert = max(1e-6, float(
+            burn_alert
+            if burn_alert is not None
+            else _env_float(_ENV_ALERT, DEFAULT_BURN_ALERT)
+        ))
         self._lock = threading.Lock()
         self._samples: Dict[str, Deque[_Sample]] = {}
         self._count_total: Dict[str, int] = {}
         self._misses_total: Dict[str, int] = {}
+        # burn accounting is TIME-bucketed, decoupled from the
+        # count-bounded quantile deque: at production verdict rates
+        # (hundreds/s) 1024 samples span seconds, which would silently
+        # collapse both burn windows onto the same sliver of history
+        # and defeat the slow window's blip forgiveness. Buckets are
+        # fast_window/20 wide; the ring holds one slow window of them
+        # per kind — bounded memory at ANY rate.
+        self._bucket_s = max(1e-3, self.fast_window_s / 20.0)
+        self._bucket_cap = int(self.slow_window_s / self._bucket_s) + 2
+        # kind -> deque of [bucket_start, count, misses]
+        self._burn_buckets: Dict[str, Deque[list]] = {}
+        # burn-alert latches + recompute throttle, per kind: the latch
+        # is the time the alert state was last CONFIRMED — a continuing
+        # storm refreshes it (no re-fire); a gap longer than the fast
+        # window (misses aged out, then a fresh excursion) re-arms it
+        self._burn_alerted_at: Dict[str, Optional[float]] = {}
+        # last latched-path re-confirmation scan per kind: while
+        # latched, misses re-evaluate at bucket granularity (the
+        # windows only move in bucket steps), not on every miss
+        self._burn_checked_at: Dict[str, float] = {}
+        self._burn_events_total: Dict[str, int] = {}
 
     def observe(
-        self, kind: str, path: str, seconds: float, missed: bool
+        self, kind: str, path: str, seconds: float, missed: bool,
+        now: float | None = None,
     ) -> None:
         """Record one resolved submission: end-to-end latency, the
         resolution path that produced the verdict, and whether it landed
-        past the deadline."""
+        past the deadline. ``now`` is injectable for deterministic
+        burn-window tests (default ``time.monotonic()``)."""
+        if now is None:
+            now = time.monotonic()
+        check_burn = False
         with self._lock:
             dq = self._samples.get(kind)
             if dq is None:
                 dq = self._samples[kind] = deque(maxlen=self.window)
                 self._count_total[kind] = 0
                 self._misses_total[kind] = 0
-            dq.append((seconds, path, missed))
+            dq.append((now, seconds, path, missed))
             self._count_total[kind] += 1
             if missed:
                 self._misses_total[kind] += 1
+            buckets = self._burn_buckets.get(kind)
+            if buckets is None:
+                buckets = self._burn_buckets[kind] = deque(
+                    maxlen=self._bucket_cap
+                )
+            start = (now // self._bucket_s) * self._bucket_s
+            if not buckets or start > buckets[-1][0]:
+                buckets.append([start, 0, 0])
+            # an out-of-order timestamp (synthetic test time) folds into
+            # the newest bucket rather than corrupting the ring order
+            buckets[-1][1] += 1
+            if missed:
+                buckets[-1][2] += 1
+                at = self._burn_alerted_at.get(kind)
+                if at is not None and now - at <= self.fast_window_s:
+                    # latched: re-CONFIRM at bucket granularity (the
+                    # windows only move in bucket steps, so finer
+                    # rechecks cannot change the answer — a storm
+                    # costs one bounded scan per bucket, not per
+                    # miss). The latch is NEVER refreshed without a
+                    # confirming scan: a sub-budget background miss
+                    # trickle would otherwise pin it alive forever
+                    # and silence every later real excursion.
+                    last = self._burn_checked_at.get(
+                        kind, -float("inf")
+                    )
+                    if now - last >= self._bucket_s:
+                        check_burn = True
+                else:
+                    # un-latched: EVERY miss evaluates (bounded bucket
+                    # scan, ≤ one slow window of buckets) — a
+                    # time-throttle here once let a sub-throttle burst
+                    # cross both windows without ever journaling
+                    check_burn = True
+        if check_burn:
+            self._recheck_burn(kind, now)
+
+    # -- burn-rate tracking ------------------------------------------------
+
+    def _window_burn_locked(
+        self, kind: str, window_s: float, now: float
+    ) -> dict:
+        """Miss ratio + burn over the trailing ``window_s``, from the
+        time-bucketed counters (bucket granularity ≈ fast/20 — a ≤5%
+        edge approximation, never a rate-dependent window collapse)."""
+        cutoff = now - window_s
+        count = misses = 0
+        for start, n, m in reversed(self._burn_buckets.get(kind) or ()):
+            if start + self._bucket_s <= cutoff:
+                break
+            count += n
+            misses += m
+        ratio = (misses / count) if count else 0.0
+        return {
+            "window_s": window_s,
+            "count": count,
+            "misses": misses,
+            "miss_ratio": round(ratio, 6),
+            "burn": (
+                round(ratio / self.budget_miss_ratio, 4) if count else None
+            ),
+        }
+
+    def _burn_kind_locked(self, kind: str, now: float) -> dict:
+        fast = self._window_burn_locked(kind, self.fast_window_s, now)
+        slow = self._window_burn_locked(kind, self.slow_window_s, now)
+        alerting = (
+            fast["burn"] is not None and fast["burn"] >= self.burn_alert
+            and slow["burn"] is not None and slow["burn"] >= self.burn_alert
+        )
+        return {
+            "fast": fast,
+            "slow": slow,
+            "alerting": alerting,
+            "events_total": self._burn_events_total.get(kind, 0),
+        }
+
+    @staticmethod
+    def _publish_burn_gauges(kind: str, doc: dict) -> None:
+        """Mirror one kind's computed burn into the gauge family —
+        called from miss-driven rechecks AND from burn()/summary()
+        reads, so a post-storm scrape decays the gauge instead of
+        freezing it at the excursion's peak (an alert on the gauge
+        would otherwise fire forever after full recovery)."""
+        for win in ("fast", "slow"):
+            burn = doc[win]["burn"]
+            _BURN_RATE.with_labels(kind, win).set(
+                burn if burn is not None else 0.0
+            )
+
+    def _recheck_burn(self, kind: str, now: float) -> None:
+        """Recompute the two burn windows for ``kind`` and drive the
+        alert latch: entering the alerting state journals ONE
+        ``slo_burn`` event per EXCURSION (the standing alert). A
+        continuing storm re-confirms the latch without re-firing; a
+        stretch longer than the fast window without a confirmed alert
+        (quiet, or background misses under budget) expires it, so the
+        next excursion alerts again even if nothing read the tracker
+        in between."""
+        with self._lock:
+            self._burn_checked_at[kind] = now
+            doc = self._burn_kind_locked(kind, now)
+            fire = False
+            if doc["alerting"]:
+                at = self._burn_alerted_at.get(kind)
+                if at is None or now - at > self.fast_window_s:
+                    fire = True
+                    self._burn_events_total[kind] = (
+                        self._burn_events_total.get(kind, 0) + 1
+                    )
+                    doc["events_total"] = self._burn_events_total[kind]
+                self._burn_alerted_at[kind] = now
+            # NOT cleared on a non-alerting recheck: re-arm is purely
+            # time-based (a quiet — or merely sub-budget — stretch
+            # longer than the fast window since the last CONFIRMED
+            # alert). A miss ratio oscillating around the budget would
+            # otherwise fire one event per re-crossing and flood the
+            # journal during a sustained near-budget storm.
+        self._publish_burn_gauges(kind, doc)
+        if fire:
+            _BURN_EVENTS.with_labels(kind).inc()
+            flight_recorder.record(
+                "slo_burn",
+                kind=kind,
+                budget_miss_ratio=self.budget_miss_ratio,
+                burn_alert=self.burn_alert,
+                fast_window_s=self.fast_window_s,
+                fast_miss_ratio=doc["fast"]["miss_ratio"],
+                fast_burn=doc["fast"]["burn"],
+                slow_window_s=self.slow_window_s,
+                slow_miss_ratio=doc["slow"]["miss_ratio"],
+                slow_burn=doc["slow"]["burn"],
+            )
+
+    def burn(self, now: float | None = None) -> dict:
+        """The miss-budget burn document: per kind, miss ratio and burn
+        multiple over the fast and slow windows, the alert latch state
+        and the per-kind alert count — plus the budget configuration.
+        The latch stays miss-driven; reads refresh the burn GAUGES so
+        they decay after a storm instead of freezing at its peak."""
+        if now is None:
+            now = time.monotonic()
+        with self._lock:
+            kinds = {
+                kind: self._burn_kind_locked(kind, now)
+                for kind in sorted(self._samples)
+            }
+        for kind, doc in kinds.items():
+            self._publish_burn_gauges(kind, doc)
+        return {
+            "budget_miss_ratio": self.budget_miss_ratio,
+            "burn_alert": self.burn_alert,
+            "fast_window_s": self.fast_window_s,
+            "slow_window_s": self.slow_window_s,
+            "kinds": kinds,
+        }
+
+    # -- totals ------------------------------------------------------------
 
     def misses_total(self) -> int:
         """Lifetime deadline misses across every kind — THE total the
@@ -98,23 +389,35 @@ class SloTracker:
         with self._lock:
             return sum(self._misses_total.values())
 
-    def summary(self, deadline_ms: float | None = None) -> dict:
+    def summary(
+        self, deadline_ms: float | None = None, now: float | None = None,
+    ) -> dict:
         """The ``slo`` document: per kind, rolling p50/p99/max over the
-        window, window miss ratio, lifetime totals, and a per-path
-        breakdown (each path's own window quantiles), so a flattering
-        fast path cannot hide a slow one's tail."""
+        window, the miss ratio in BOTH scopes (window-scoped and
+        lifetime — never mixed, see module docstring), lifetime totals,
+        a per-path breakdown (each path's own window quantiles) so a
+        flattering fast path cannot hide a slow one's tail, and the
+        per-kind burn-rate block."""
+        if now is None:
+            now = time.monotonic()
         with self._lock:
             snap = {k: list(dq) for k, dq in self._samples.items()}
             counts = dict(self._count_total)
             misses = dict(self._misses_total)
+            burn_kinds = {
+                kind: self._burn_kind_locked(kind, now)
+                for kind in sorted(self._samples)
+            }
+        for kind, bdoc in burn_kinds.items():
+            self._publish_burn_gauges(kind, bdoc)
         kinds = {}
         for kind in sorted(snap):
             samples = snap[kind]
-            lat = sorted(s[0] for s in samples)
-            window_misses = sum(1 for s in samples if s[2])
+            lat = sorted(s[1] for s in samples)
+            window_misses = sum(1 for s in samples if s[3])
             paths = {}
-            for path in sorted({s[1] for s in samples}):
-                plat = sorted(s[0] for s in samples if s[1] == path)
+            for path in sorted({s[2] for s in samples}):
+                plat = sorted(s[1] for s in samples if s[2] == path)
                 paths[path] = {
                     "count": len(plat),
                     "p50_ms": quantile_ms(plat, 0.50),
@@ -131,9 +434,26 @@ class SloTracker:
                 "window_miss_ratio": (
                     round(window_misses / len(samples), 4) if samples else 0.0
                 ),
+                # explicitly lifetime-scoped (ISSUE 14 satellite): the
+                # lifetime numerator over the lifetime denominator — a
+                # reader never has to divide across scopes
+                "lifetime_miss_ratio": (
+                    round(misses[kind] / counts[kind], 6)
+                    if counts[kind] else 0.0
+                ),
                 "paths": paths,
+                "burn": burn_kinds.get(kind),
             }
-        doc = {"window": self.window, "kinds": kinds}
+        doc = {
+            "window": self.window,
+            "kinds": kinds,
+            "burn_config": {
+                "budget_miss_ratio": self.budget_miss_ratio,
+                "burn_alert": self.burn_alert,
+                "fast_window_s": self.fast_window_s,
+                "slow_window_s": self.slow_window_s,
+            },
+        }
         if deadline_ms is not None:
             doc["deadline_ms"] = round(float(deadline_ms), 3)
         return doc

@@ -366,6 +366,63 @@ def bulk_backfill(
     return _finish(evs)
 
 
+def saturation_ramp(
+    duration_s: float = 20.0,
+    seed: int = 0,
+    rate_scale: float = 1.0,
+    committee: int = 8,
+    start_rate: float = 5.0,
+    end_rate: float = 80.0,
+    agg_fraction: float = 0.25,
+    backfill_every_s: float = 4.0,
+    backfill_sets: int = 48,
+    slice_s: float = 0.5,
+) -> List[dict]:
+    """The capacity-certification shape (ISSUE 14): gossip arrival rate
+    rising LINEARLY from ``start_rate`` to ``end_rate`` events/s over
+    the trace (an inhomogeneous Poisson process, realized as
+    piecewise-constant ``slice_s`` slices with the rate interpolated at
+    each slice midpoint — deterministic under seed like every other
+    generator), split ``agg_fraction`` committee-width aggregates /
+    the rest single-pubkey attestations, over a bulk-backfill FLOOR
+    (large deadline-insensitive submissions every ``backfill_every_s``).
+    Somewhere along the ramp demand crosses serving capacity: the trace
+    the headroom estimator is certified against (headroom must cross
+    below its alert threshold and an ``slo_burn`` event must journal
+    BEFORE the first deadline-miss burst — the estimator is predictive,
+    not retrospective), and the missing precursor for ROADMAP item 2's
+    bulk-QoS admission-control work."""
+    rng = random.Random(seed)
+    evs: List[dict] = []
+    t0 = 0.0
+    while t0 < duration_s:
+        t1 = min(duration_s, t0 + slice_s)
+        frac = ((t0 + t1) / 2.0) / duration_s
+        rate = (start_rate + (end_rate - start_rate) * frac) * rate_scale
+        evs += _poisson(
+            rng, rate * (1.0 - agg_fraction), t0, t1,
+            lambda t, r: {"t": t, "kind": "unaggregated", "n_sets": 1,
+                          "pubkeys": 1, "messages": 1, "path": "submit"},
+        )
+        evs += _poisson(
+            rng, rate * agg_fraction, t0, t1,
+            lambda t, r: {"t": t, "kind": "aggregate", "n_sets": 1,
+                          "pubkeys": committee, "messages": 1,
+                          "path": "submit"},
+        )
+        t0 = t1
+    t = rng.uniform(0.0, backfill_every_s)
+    while t < duration_s:
+        evs.append({
+            "t": round(t, 6), "kind": "backfill",
+            "n_sets": int(backfill_sets), "pubkeys": committee,
+            "messages": max(1, int(backfill_sets) // 8),
+            "path": "submit",
+        })
+        t += backfill_every_s * rng.uniform(0.8, 1.2)
+    return _finish(evs)
+
+
 # Generator catalogue: every entry documented in docs/TRAFFIC_REPLAY.md
 # (linted by tests/test_zgate4_metrics_lint.py).
 GENERATORS: Dict[str, Callable[..., List[dict]]] = {
@@ -373,6 +430,7 @@ GENERATORS: Dict[str, Callable[..., List[dict]]] = {
     "epoch_boundary_flood": epoch_boundary_flood,
     "sync_committee_period": sync_committee_period,
     "bulk_backfill": bulk_backfill,
+    "saturation_ramp": saturation_ramp,
 }
 
 

@@ -327,6 +327,277 @@ def test_slo_tracker_rolling_window():
     assert rec["window_miss_ratio"] == 0.0
 
 
+def test_slo_ratio_scopes_never_mixed():
+    """ISSUE 14 satellite: after the rolling window has evicted old
+    misses, the window ratio and the lifetime ratio DIVERGE and both
+    are served explicitly — a reader never has to divide a lifetime
+    numerator by a windowed denominator."""
+    t = SloTracker(window=4)
+    for _ in range(4):
+        t.observe("k", "fused", 1.0, True)   # lifetime misses, evicted
+    for _ in range(4):
+        t.observe("k", "fused", 0.010, False)
+    rec = t.summary(deadline_ms=25.0)["kinds"]["k"]
+    assert rec["window_miss_ratio"] == 0.0          # window-scoped
+    assert rec["lifetime_miss_ratio"] == 0.5        # lifetime-scoped
+    assert rec["misses_total"] == 4 and rec["count_total"] == 8
+
+
+def test_burn_rate_multi_window_alert(recorder):
+    """ISSUE 14: the miss-budget burn is tracked over fast AND slow
+    windows; both crossing the alert threshold journals ONE slo_burn
+    event (latched per excursion, not per miss), ticks the event
+    counter and serves the live burn rates."""
+    t = SloTracker(
+        window=256, budget_miss_ratio=0.04, fast_window_s=2.0,
+        slow_window_s=8.0, burn_alert=1.0,
+    )
+    base = 1000.0
+    # healthy traffic: burn 0, no alert
+    for i in range(40):
+        t.observe("gossip", "fused", 0.01, False, now=base + i * 0.1)
+    b = t.burn(now=base + 4.0)["kinds"]["gossip"]
+    assert b["fast"]["burn"] == 0.0 and not b["alerting"]
+    # FIRST miss: fast window (1/21 = 0.048 -> burn 1.19) crosses, the
+    # slow window (1/41 = 0.024 -> burn 0.61) does not — the
+    # multi-window AND suppresses the blip, no event yet
+    before = fr.events(kinds=["slo_burn"])
+    t.observe("gossip", "fused", 0.5, True, now=base + 4.0)
+    mid = t.burn(now=base + 4.0)["kinds"]["gossip"]
+    assert mid["fast"]["burn"] >= 1.0 and mid["slow"]["burn"] < 1.0
+    assert mid["alerting"] is False
+    assert len(fr.events(kinds=["slo_burn"])) == len(before)
+    # SECOND miss: both windows over budget -> the standing alert fires
+    t.observe("gossip", "fused", 0.5, True, now=base + 4.1)
+    doc = t.burn(now=base + 4.1)["kinds"]["gossip"]
+    assert doc["fast"]["burn"] >= 1.0 and doc["slow"]["burn"] >= 1.0
+    assert doc["alerting"] is True
+    events = fr.events(kinds=["slo_burn"])
+    assert len(events) == len(before) + 1  # latched: one per excursion
+    ev = events[-1]["fields"]
+    assert ev["kind"] == "gossip"
+    assert ev["fast_burn"] >= 1.0 and ev["slow_burn"] >= 1.0
+    assert ev["budget_miss_ratio"] == 0.04
+    # more misses while latched: no extra event
+    for i in range(5):
+        t.observe("gossip", "fused", 0.5, True, now=base + 4.2 + i * 0.1)
+    assert len(fr.events(kinds=["slo_burn"])) == len(before) + 1
+    assert doc["events_total"] == 1
+    # the summary's burn block and the metric families carry the state
+    summ = t.summary(deadline_ms=25.0, now=base + 4.7)
+    assert summ["kinds"]["gossip"]["burn"]["alerting"] is True
+    assert summ["burn_config"]["budget_miss_ratio"] == 0.04
+    rate = metrics.get("verification_scheduler_slo_burn_rate")
+    assert rate.with_labels("gossip", "fast").value >= 1.0
+    ev_counter = metrics.get(
+        "verification_scheduler_slo_burn_events_total"
+    )
+    assert ev_counter.with_labels("gossip").value >= 1
+
+
+def test_burn_windows_survive_quantile_deque_clamp(recorder):
+    """Burn accounting is time-bucketed, decoupled from the
+    count-bounded quantile deque: at high verdict rates a tiny sample
+    window must NOT collapse the slow burn window onto the fast one —
+    the slow window's blip forgiveness is the point of the AND."""
+    t = SloTracker(
+        window=16,  # quantile deque spans ~8 s of this traffic only
+        budget_miss_ratio=0.02, fast_window_s=2.0, slow_window_s=50.0,
+        burn_alert=1.0,
+    )
+    base = 5000.0
+    for i in range(200):  # 100 s of clean traffic at 2/s
+        t.observe("k", "fused", 0.01, False, now=base + i * 0.5)
+    before = len(fr.events(kinds=["slo_burn"]))
+    t.observe("k", "fused", 0.5, True, now=base + 100.0)
+    doc = t.burn(now=base + 100.0)["kinds"]["k"]
+    # fast window: ~5 samples, 1 miss -> burning hard
+    assert doc["fast"]["burn"] >= 1.0
+    # slow window: ~100 samples (despite the 16-sample deque), 1 miss
+    # -> ratio ~0.01 < 0.02 budget: the blip is forgiven, no alert
+    assert doc["slow"]["count"] >= 90
+    assert doc["slow"]["burn"] < 1.0
+    assert doc["alerting"] is False
+    assert len(fr.events(kinds=["slo_burn"])) == before
+
+
+def test_burn_latch_does_not_flood_on_oscillation(recorder):
+    """A miss ratio oscillating around the budget within one fast
+    window journals ONE event, not one per re-crossing — re-arm is
+    purely time-based (a quiet gap longer than the fast window)."""
+    t = SloTracker(
+        window=256, budget_miss_ratio=0.05, fast_window_s=2.0,
+        slow_window_s=4.0, burn_alert=1.0,
+    )
+    base = 6000.0
+    before = len(fr.events(kinds=["slo_burn"]))
+    for i in range(5):
+        t.observe("k", "fused", 0.01, False, now=base + i * 0.1)
+    # oscillate: miss (alert) -> clean dip below threshold -> miss
+    # again, all inside the 2 s fast window
+    t.observe("k", "fused", 0.5, True, now=base + 0.5)
+    for i in range(40):  # dilute: burn dips below the threshold
+        t.observe("k", "fused", 0.01, False, now=base + 0.6 + i * 0.01)
+    t.observe("k", "fused", 0.5, True, now=base + 1.1)
+    t.observe("k", "fused", 0.5, True, now=base + 1.2)
+    assert len(fr.events(kinds=["slo_burn"])) == before + 1
+
+
+def test_burn_latch_not_pinned_by_subbudget_trickle(recorder):
+    """After an excursion, a steady BACKGROUND miss trickle (under
+    budget — every healthy node has one) must not keep re-confirming
+    the latch: a later real excursion still fires its own slo_burn
+    event. The latch only refreshes on a CONFIRMED alert."""
+    t = SloTracker(
+        window=1024, budget_miss_ratio=0.25, fast_window_s=2.0,
+        slow_window_s=4.0, burn_alert=1.0,
+    )
+    base = 8000.0
+    before = len(fr.events(kinds=["slo_burn"]))
+    # excursion 1: half the traffic misses -> alert
+    for i in range(4):
+        t.observe("k", "fused", 0.5, i % 2 == 0, now=base + i * 0.1)
+    assert len(fr.events(kinds=["slo_burn"])) == before + 1
+    # sub-budget trickle: one miss per second among 9 clean (ratio 0.1
+    # << 0.25 budget), every gap shorter than the fast window — the
+    # old refresh-on-any-miss latch stayed pinned through this forever
+    tt = base + 1.0
+    for _ in range(12):
+        t.observe("k", "fused", 0.5, True, now=tt)
+        for j in range(9):
+            t.observe("k", "fused", 0.01, False, now=tt + 0.1 + j * 0.09)
+        tt += 1.0
+    # excursion 2: a real saturation burst -> a SECOND event must fire
+    for i in range(12):
+        t.observe("k", "fused", 0.5, True, now=tt + i * 0.01)
+    assert len(fr.events(kinds=["slo_burn"])) == before + 2
+
+
+def test_burn_alert_fires_inside_instant_miss_burst(recorder):
+    """A miss burst tighter than any throttle window must still alert:
+    every un-latched miss evaluates the (bounded, bucketed) windows, so
+    the alert fires at exactly the miss that crosses both — even when
+    all the misses share one timestamp (a whole flush resolving at
+    once)."""
+    t = SloTracker(
+        window=256, budget_miss_ratio=0.04, fast_window_s=2.0,
+        slow_window_s=8.0, burn_alert=1.0,
+    )
+    base = 7000.0
+    for i in range(40):
+        t.observe("k", "fused", 0.01, False, now=base + i * 0.1)
+    before = len(fr.events(kinds=["slo_burn"]))
+    # three misses at the SAME instant: #1 leaves the slow window under
+    # budget (no alert), #2 crosses both — the event must fire right
+    # there, not wait for a later recheck that may never come
+    for _ in range(3):
+        t.observe("k", "fused", 0.5, True, now=base + 4.0)
+    assert len(fr.events(kinds=["slo_burn"])) == before + 1
+
+
+def test_compile_service_cost_gauge_excludes_first_dispatch():
+    """The rung-cost feed is WARM-only: each rung's first dispatch
+    (whose wall includes the XLA compile) must not poison the capacity
+    dial — one 170s cold compile over 4 sets would read as saturated
+    for thousands of sets."""
+    from lighthouse_tpu.compile_service import CompileService
+
+    svc = CompileService(rungs=((4, 1, 1),))
+    g = metrics.get("compile_service_measured_cost_seconds_per_set")
+    g.set(0.0)
+    # first dispatch at the rung: the (simulated) compile wall
+    svc.note_rung_verified(4, 1, 1, seconds=170.0, n_sets=4)
+    assert g.value == 0.0  # excluded: nothing warm measured yet
+    # warm dispatches feed the gauge
+    svc.note_rung_verified(4, 1, 1, seconds=0.02, n_sets=4)
+    svc.note_rung_verified(4, 1, 1, seconds=0.02, n_sets=4)
+    assert g.value == pytest.approx(0.005)
+    # compiles are PER CHIP: a failover re-verify on a shard where the
+    # rung is still cold pays the compile again — its wall must be
+    # excluded too, not counted warm because device 0 already was
+    svc.note_rung_verified(4, 1, 1, seconds=170.0, n_sets=4, device=1)
+    assert g.value == pytest.approx(0.005)
+    costs = svc.measured_rung_costs()
+    rec = costs["rungs"]["4x1x1@dev0"]
+    assert rec["dispatches"] == 3  # per-rung record keeps ALL walls
+    assert rec["sum_s"] == pytest.approx(170.04)
+    assert costs["rungs"]["4x1x1@dev1"]["dispatches"] == 1
+    assert costs["s_per_set"] == pytest.approx(0.005)  # warm-only
+
+
+def test_burn_gauge_decays_on_reads_after_recovery(recorder):
+    """The burn gauge must not freeze at a storm's peak: a burn()/
+    summary() read after the misses aged out decays it to 0, so a
+    Prometheus alert on the gauge stops firing once the node
+    recovered."""
+    t = SloTracker(
+        window=256, budget_miss_ratio=0.05, fast_window_s=2.0,
+        slow_window_s=4.0, burn_alert=1.0,
+    )
+    base = 3000.0
+    for i in range(10):
+        t.observe("k", "fused", 0.01, False, now=base + i * 0.1)
+    t.observe("k", "fused", 0.5, True, now=base + 1.0)
+    rate = metrics.get("verification_scheduler_slo_burn_rate")
+    assert rate.with_labels("k", "fast").value >= 1.0
+    # storm over, misses aged out of both windows: a read decays it
+    t.summary(now=base + 30.0)
+    assert rate.with_labels("k", "fast").value == 0.0
+    assert rate.with_labels("k", "slow").value == 0.0
+
+
+def test_burn_latch_rearms_after_recovery(recorder):
+    """The alert latch re-arms once the fast window cools below the
+    threshold: a second excursion journals a second event."""
+    t = SloTracker(
+        window=256, budget_miss_ratio=0.05, fast_window_s=2.0,
+        slow_window_s=4.0, burn_alert=1.0,
+    )
+    base = 2000.0
+    before = len(fr.events(kinds=["slo_burn"]))
+    for i in range(10):
+        t.observe("k", "fused", 0.01, False, now=base + i * 0.1)
+    t.observe("k", "fused", 0.5, True, now=base + 1.0)
+    t.observe("k", "fused", 0.5, True, now=base + 1.1)
+    assert len(fr.events(kinds=["slo_burn"])) == before + 1
+    # recovery: enough clean traffic that the fast window's ratio drops
+    # below budget (misses age out of the 2 s fast window)
+    for i in range(100):
+        t.observe("k", "fused", 0.01, False, now=base + 4.0 + i * 0.05)
+    assert t.burn(now=base + 9.0)["kinds"]["k"]["alerting"] is False
+    # second excursion -> second event
+    t.observe("k", "fused", 0.5, True, now=base + 20.0)
+    t.observe("k", "fused", 0.5, True, now=base + 20.1)
+    assert len(fr.events(kinds=["slo_burn"])) == before + 2
+
+
+def test_scheduler_arrival_accounting(fake_backend):
+    """ISSUE 14: arrivals are counted at SUBMISSION time per kind and
+    entry path — including verify_now — so the capacity estimator's
+    utilization numerator measures demand, not serving throughput."""
+    m = metrics.counter_vec(
+        "verification_scheduler_arrival_sets_total",
+        labelnames=("kind", "path"),
+    )
+
+    def count(kind, path):
+        return m.with_labels(kind, path).value
+
+    before_submit = count("unaggregated", "submit")
+    before_bypass = count("block", "bypass")
+    sched = _scheduler()
+    try:
+        assert sched.submit(
+            [_set(), _set()], "unaggregated"
+        ).result(5) is True
+        assert sched.verify_now([_set()], "block") is True
+        assert sched.submit([], "unaggregated").result(1) is False
+    finally:
+        sched.stop()
+    assert count("unaggregated", "submit") == before_submit + 2
+    assert count("block", "bypass") == before_bypass + 1
+
+
 def test_health_endpoint_serves_slo_block(fake_backend, recorder):
     """/lighthouse/health carries the top-level slo block when a
     scheduler is attached (rolling p50/p99 + miss ratio per kind) and

@@ -32,6 +32,7 @@ KNOWN_PREFIXES = (
     "beacon_processor_",
     "block_",
     "bls_device_",
+    "capacity_",  # timeseries sampler + headroom estimator (ISSUE 14)
     "compile_service_",
     "device_",  # device_memory_bytes (utils/transfer_ledger.py, ISSUE 8)
     "fault_",  # fault-injection layer (utils/fault_injection.py, ISSUE 13)
@@ -71,6 +72,7 @@ def _import_instrumented_modules():
     import lighthouse_tpu.utils.flight_recorder  # noqa: F401
     import lighthouse_tpu.utils.logging  # noqa: F401
     import lighthouse_tpu.utils.monitoring  # noqa: F401
+    import lighthouse_tpu.utils.timeseries  # noqa: F401
     import lighthouse_tpu.verification_service.batcher  # noqa: F401
 
 
@@ -435,6 +437,77 @@ def test_pipeline_profiler_families_registered():
         "queue_wait", "plan", "pack", "device", "fallback", "resolve",
     )
     import tools.pipeline_report  # noqa: F401
+
+
+def test_capacity_timeseries_and_burn_families_registered():
+    """ISSUE 14 families (utils/timeseries.py + the SLO burn layer +
+    the scheduler's arrival accounting + the compile service's
+    rung-cost feed) exist under their declared types + labels, the
+    sampler allowlist stays a sorted documented registry like
+    EVENT_KINDS, and the report tool imports cleanly (jax-freedom is
+    subprocess-pinned in tests/test_timeseries_capacity.py)."""
+    _import_instrumented_modules()
+    reg = metrics.registry_snapshot()
+    want = {
+        "capacity_estimated_sets_per_sec": ("gauge", None),
+        "capacity_utilization": ("gauge", None),
+        "capacity_headroom_ratio": ("gauge", None),
+        "capacity_sampler_samples_total": ("counter", None),
+        "capacity_sampler_errors_total": ("counter", None),
+        "capacity_sampler_memory_bytes": ("gauge", None),
+        "verification_scheduler_arrival_sets_total": (
+            "counter", ("kind", "path"),
+        ),
+        "verification_scheduler_slo_burn_rate": (
+            "gauge", ("kind", "window"),
+        ),
+        "verification_scheduler_slo_burn_events_total": (
+            "counter", ("kind",),
+        ),
+        "compile_service_measured_cost_seconds_per_set": ("gauge", None),
+    }
+    for name, (kind, labels) in want.items():
+        m = reg.get(name)
+        assert m is not None, f"family {name} not registered"
+        assert m.kind == kind, (name, m.kind)
+        if labels is not None:
+            assert m.labelnames == labels, (name, m.labelnames)
+        else:
+            assert not hasattr(m, "labelnames"), name  # unlabeled family
+    # the sampler allowlist is a registry: sorted, unique, snake_case,
+    # capacity_-prefixed, every family documented in OBSERVABILITY.md —
+    # an undeclared series cannot silently appear in the endpoint
+    import os
+
+    from lighthouse_tpu.utils import timeseries
+
+    fams = [s.family for s in timeseries.SAMPLE_FAMILIES]
+    assert fams, "sampler allowlist must not be empty"
+    assert fams == sorted(fams)
+    assert len(set(fams)) == len(fams)
+    docs = open(
+        os.path.join(
+            os.path.dirname(__file__), "..", "docs", "OBSERVABILITY.md"
+        )
+    ).read()
+    for spec in timeseries.SAMPLE_FAMILIES:
+        assert _NAME.match(spec.family), spec.family
+        assert spec.family.startswith("capacity_"), spec.family
+        assert f"`{spec.family}`" in docs, (
+            f"sampler family {spec.family!r} missing from "
+            f"docs/OBSERVABILITY.md — the allowlist must stay documented"
+        )
+        assert spec.mode in ("gauge", "rate", "ratio", "derived"), spec.mode
+        # non-derived families read a real registry family by name
+        if spec.mode != "derived":
+            assert spec.source, spec.family
+    # the timeseries schema is a versioned identifier like the trace
+    # schema, and the tier catalogue is pinned (docs + endpoint grammar)
+    assert re.fullmatch(
+        r"lighthouse_tpu\.timeseries/\d+", timeseries.SCHEMA
+    ), timeseries.SCHEMA
+    assert timeseries.TIER_NAMES == ("raw", "1m", "10m")
+    import tools.capacity_report  # noqa: F401
 
 
 def test_warmup_tool_imports_and_dry_run_lists_ladder(capsys, monkeypatch):

@@ -78,6 +78,15 @@ METRICS = (
      ("pipeline_leg", "flush_thread_saturation"), None),
     ("pipeline_overlap_speedup",
      ("pipeline_leg", "overlap", "projected_speedup"), True),
+    # ISSUE 14: the capacity leg — the headroom estimator lockstep-
+    # replayed over a saturation_ramp at the run's measured headline
+    # cost. LEARNED, not gated (None direction / absent from GATED):
+    # the ramp is rescaled to each run's capacity, so these numbers
+    # track the estimator's behavior, not a throughput SLO
+    ("capacity_headroom_ratio", ("capacity_leg", "headroom_ratio"), None),
+    ("capacity_predictive_lead_s",
+     ("capacity_leg", "predictive_lead_s"), None),
+    ("capacity_saturated_at_s", ("capacity_leg", "saturated_at_s"), None),
     # ISSUE 13: the chaos leg — injected shard loss + in-replay
     # recovery. time-to-recover is gated (slower recovery = leaked
     # verify capacity, the thing the self-healing mesh exists to

@@ -1,0 +1,422 @@
+"""Render capacity & saturation observability (ISSUE 14) — live node
+series as sparkline tables, or a jax-free lockstep replay of a trace
+through the capacity/headroom estimator to predict where a ramp
+saturates.
+
+The same live/model split as ``tools/transfer_report.py`` and
+``tools/pipeline_report.py``:
+
+    # live node: retained series (/lighthouse/timeseries) rendered as
+    # sparkline tables + the capacity block and SLO burn rates
+    python tools/capacity_report.py --url http://127.0.0.1:5052
+    python tools/capacity_report.py --url ... --tier 1m --window 3600
+
+    # jax-free replay model: walk a trace's arrivals through the
+    # estimator with an explicit (or bench-measured) serving cost and
+    # predict the saturation point, the miss onset, and the predictive
+    # lead between them
+    python tools/capacity_report.py --generate saturation_ramp \\
+        --duration 20 --cost-per-set 0.02 --json
+    python tools/capacity_report.py --trace /tmp/ramp.jsonl \\
+        --capacity-sets-per-sec 120 --deadline-ms 25
+
+Model mode is the certification surface for the acceptance property
+"the estimator is predictive, not retrospective": on a
+``saturation_ramp`` trace, ``saturated_at_s`` (headroom crossing below
+``--headroom-alert``, default 0.2) must come STRICTLY before
+``miss_onset_s`` (the modeled queue wait first exceeding the SLO budget
+``deadline × slo_grace``) — the backlog integral needs time to grow
+after utilization crosses 1.0, and headroom crosses its threshold while
+utilization is still below 1.0. ``predictive_lead_s`` is that gap: how
+much warning the admission-control gate (ROADMAP item 2) gets.
+
+Queue model (stated, not hidden): arrivals integrate from the trace per
+``--step-s`` grid cell; serving drains at the modeled capacity;
+``backlog(t+dt) = max(0, backlog + arrivals − capacity·dt)`` and the
+oldest-submission wait is ``backlog / capacity``. The model ignores
+batching granularity and flush triggers — it predicts the ONSET of
+sustained misses, not individual trigger-timing misses, which is
+exactly what a burn-rate alert fires on.
+
+Jax-free (subprocess-pinned by tests/test_timeseries_capacity.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPORT_SCHEMA = "lighthouse_tpu.capacity_report/1"
+
+SPARK_CHARS = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values, width: int = 48) -> str:
+    """Unicode sparkline of ``values`` downsampled to ``width`` cells
+    (bucket means), scaled min→max (flat series render as all-low)."""
+    vals = [float(v) for v in values]
+    if not vals:
+        return ""
+    if len(vals) > width:
+        # bucket means so a long series still shows its shape
+        out = []
+        n = len(vals)
+        for i in range(width):
+            lo = i * n // width
+            hi = max(lo + 1, (i + 1) * n // width)
+            out.append(sum(vals[lo:hi]) / (hi - lo))
+        vals = out
+    vmin, vmax = min(vals), max(vals)
+    span = vmax - vmin
+    if span <= 0:
+        return SPARK_CHARS[0] * len(vals)
+    return "".join(
+        SPARK_CHARS[
+            min(len(SPARK_CHARS) - 1,
+                int((v - vmin) / span * len(SPARK_CHARS)))
+        ]
+        for v in vals
+    )
+
+
+# ---------------------------------------------------------------------------
+# Model mode: lockstep replay through the estimator (jax-free)
+# ---------------------------------------------------------------------------
+
+
+def replay_estimator(
+    events,
+    cost_s_per_set: float | None = None,
+    capacity_sets_per_sec: float | None = None,
+    shards: int = 1,
+    deadline_ms: float = 25.0,
+    slo_grace: float = 2.0,
+    step_s: float = 0.25,
+    arrival_window_s: float = 1.0,
+    headroom_alert: float = 0.2,
+) -> dict:
+    """Walk ``events`` (arrival-trace dicts, ``traffic.py`` schema) on a
+    ``step_s`` grid through THE capacity estimator
+    (``utils/timeseries.estimate_capacity`` with ``publish=False`` —
+    the same function the live dial serves, so the certification model
+    cannot silently drift from the node; jax-free either way), plus
+    the explicit queue model (module docstring) → predicted miss
+    onset. Returns the timeline and the three headline predictions
+    (``saturated_at_s``, ``miss_onset_s``, ``predictive_lead_s``).
+    Pure function of its inputs — the determinism tests pin it."""
+    from lighthouse_tpu.utils import timeseries
+
+    if capacity_sets_per_sec is None:
+        if not cost_s_per_set or cost_s_per_set <= 0:
+            raise ValueError(
+                "need cost_s_per_set > 0 or capacity_sets_per_sec"
+            )
+        capacity_sets_per_sec = shards / cost_s_per_set
+    budget_s = (deadline_ms / 1000.0) * slo_grace
+    events = sorted(events, key=lambda e: e["t"])
+    duration = events[-1]["t"] if events else 0.0
+    n_steps = int(duration / step_s) + 1
+    arrivals_per_step = [0.0] * (n_steps + 1)
+    for ev in events:
+        arrivals_per_step[min(n_steps, int(ev["t"] / step_s))] += ev["n_sets"]
+    window_steps = max(1, int(round(arrival_window_s / step_s)))
+    timeline = []
+    backlog = 0.0
+    saturated_at = miss_onset = None
+    headroom_min = 1.0
+    for i in range(n_steps + 1):
+        t = i * step_s
+        lo = max(0, i - window_steps + 1)
+        window = arrivals_per_step[lo:i + 1]
+        arrival_rate = sum(window) / (len(window) * step_s)
+        est = timeseries.estimate_capacity(
+            arrival_sets_per_sec=arrival_rate,
+            cost_s_per_set=1.0 / capacity_sets_per_sec,
+            shards=1,
+            publish=False,
+        )
+        utilization = est["utilization"]
+        headroom = est["headroom_ratio"]
+        headroom_min = min(headroom_min, headroom)
+        backlog = max(
+            0.0,
+            backlog + arrivals_per_step[i] - capacity_sets_per_sec * step_s,
+        )
+        wait_s = backlog / capacity_sets_per_sec
+        if saturated_at is None and headroom < headroom_alert:
+            saturated_at = t
+        if miss_onset is None and wait_s > budget_s:
+            miss_onset = t
+        timeline.append({
+            "t": round(t, 6),
+            "arrival_sets_per_sec": round(arrival_rate, 3),
+            "utilization": round(utilization, 4),
+            "headroom_ratio": round(headroom, 4),
+            "backlog_sets": round(backlog, 2),
+            "wait_ms": round(wait_s * 1000.0, 3),
+        })
+    return {
+        "schema": REPORT_SCHEMA,
+        "mode": "model",
+        "n_events": len(events),
+        "n_sets": sum(ev["n_sets"] for ev in events),
+        "duration_s": round(duration, 6),
+        "model": {
+            "capacity_sets_per_sec": round(capacity_sets_per_sec, 3),
+            "cost_s_per_set": (
+                round(cost_s_per_set, 9) if cost_s_per_set else None
+            ),
+            "shards": shards,
+            "deadline_ms": deadline_ms,
+            "slo_grace": slo_grace,
+            "budget_ms": round(budget_s * 1000.0, 3),
+            "step_s": step_s,
+            "arrival_window_s": arrival_window_s,
+            "headroom_alert": headroom_alert,
+            "assumptions": (
+                "fluid queue: arrivals integrate per step, serving "
+                "drains at modeled capacity, wait = backlog/capacity; "
+                "batching granularity and flush triggers not modeled — "
+                "this predicts the onset of SUSTAINED misses"
+            ),
+        },
+        "saturated_at_s": saturated_at,
+        "miss_onset_s": miss_onset,
+        "predictive_lead_s": (
+            round(miss_onset - saturated_at, 6)
+            if saturated_at is not None and miss_onset is not None else None
+        ),
+        "headroom_min": round(headroom_min, 4),
+        "headroom_final": timeline[-1]["headroom_ratio"] if timeline else None,
+        "peak_wait_ms": max(p["wait_ms"] for p in timeline) if timeline else 0,
+        "timeline": timeline,
+    }
+
+
+def render_model(rep: dict) -> str:
+    m = rep["model"]
+    tl = rep["timeline"]
+    lines = [
+        f"capacity replay model: {rep['n_events']} events / "
+        f"{rep['n_sets']} sets over {rep['duration_s']:.1f}s "
+        f"(capacity {m['capacity_sets_per_sec']} sets/s, "
+        f"{m['shards']} shard(s), budget {m['budget_ms']} ms)",
+        f"  arrival  {sparkline([p['arrival_sets_per_sec'] for p in tl])}",
+        f"  headroom {sparkline([p['headroom_ratio'] for p in tl])}",
+        f"  wait_ms  {sparkline([p['wait_ms'] for p in tl])}",
+        f"  headroom crosses < {m['headroom_alert']}: "
+        + (f"t={rep['saturated_at_s']:.2f}s"
+           if rep["saturated_at_s"] is not None else "never"),
+        f"  modeled miss onset (wait > budget): "
+        + (f"t={rep['miss_onset_s']:.2f}s"
+           if rep["miss_onset_s"] is not None else "never"),
+    ]
+    if rep["predictive_lead_s"] is not None:
+        lines.append(
+            f"  predictive lead: {rep['predictive_lead_s']:.2f}s of "
+            f"warning before sustained misses"
+        )
+    lines.append(
+        f"  headroom min {rep['headroom_min']} / final "
+        f"{rep['headroom_final']}; peak wait {rep['peak_wait_ms']:.1f} ms"
+    )
+    lines.append(f"  assumptions: {m['assumptions']}")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Live mode
+# ---------------------------------------------------------------------------
+
+
+def fetch_json(url: str) -> dict:
+    from urllib.request import urlopen
+
+    with urlopen(url, timeout=10) as r:
+        return json.load(r)["data"]
+
+
+def live_report(base_url: str, tier: str = "raw",
+                window_s: float | None = None,
+                families=None) -> dict:
+    base = base_url.rstrip("/")
+    q = [f"tier={tier}"]
+    if window_s is not None:
+        q.append(f"window={window_s:g}")
+    if families:
+        q.append("family=" + ",".join(families))
+    series = fetch_json(base + "/lighthouse/timeseries?" + "&".join(q))
+    health = fetch_json(base + "/lighthouse/health")
+    return {
+        "schema": REPORT_SCHEMA,
+        "mode": "live",
+        "url": base,
+        "timeseries": series,
+        "capacity": health.get("capacity"),
+        "slo": health.get("slo"),
+    }
+
+
+def _series_values(points, tier: str):
+    # raw points are (t, v); downsampled (t, min, max, mean, count)
+    idx = 1 if tier == "raw" else 3
+    return [p[idx] for p in points]
+
+
+def render_live(rep: dict) -> str:
+    ts = rep["timeseries"]
+    tier = ts["tier"]
+    lines = [
+        f"capacity report: {rep['url']} (tier {tier}"
+        + (f", window {ts['window_s']:g}s" if ts.get("window_s") else "")
+        + ")",
+        f"  {'series':<42}{'n':>5}{'min':>12}{'mean':>12}{'max':>12}"
+        f"{'last':>12}  shape",
+    ]
+    for fam in sorted(ts["families"]):
+        for label, points in sorted(ts["families"][fam].items()):
+            vals = _series_values(points, tier)
+            if not vals:
+                continue
+            name = f"{fam}{{{label}}}" if label else fam
+            lines.append(
+                f"  {name:<42}{len(vals):>5}{min(vals):>12.4g}"
+                f"{sum(vals) / len(vals):>12.4g}{max(vals):>12.4g}"
+                f"{vals[-1]:>12.4g}  {sparkline(vals)}"
+            )
+    cap = rep.get("capacity") or {}
+    est = cap.get("estimate")
+    if est:
+        lines.append(
+            f"  estimate: capacity={est.get('estimated_sets_per_sec')} "
+            f"sets/s (cost {est.get('cost_s_per_set')}s/set from "
+            f"{est.get('cost_source')}, {est.get('shards')} shard(s)); "
+            f"arrival={est.get('arrival_sets_per_sec')} sets/s; "
+            f"utilization={est.get('utilization')}; "
+            f"headroom={est.get('headroom_ratio')}"
+        )
+    else:
+        lines.append("  estimate: none yet (no measured cost or arrivals)")
+    store = cap.get("store") or {}
+    if store:
+        lines.append(
+            f"  store: {store.get('series')} series, "
+            f"{store.get('recorded_total')} points recorded, "
+            f"~{store.get('memory_bytes_est', 0) / 1024:.0f} KiB of "
+            f"{store.get('memory_bound_bytes', 0) / 1024:.0f} KiB bound"
+        )
+    slo = rep.get("slo") or {}
+    for kind, rec in sorted((slo.get("kinds") or {}).items()):
+        burn = rec.get("burn") or {}
+        fast = (burn.get("fast") or {}).get("burn")
+        slow = (burn.get("slow") or {}).get("burn")
+        if fast is None and slow is None:
+            continue
+        flag = "  << BURNING" if burn.get("alerting") else ""
+        lines.append(
+            f"  burn {kind:<20} fast={fast} slow={slow} "
+            f"(events {burn.get('events_total', 0)}){flag}"
+        )
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--url", help="live node base URL")
+    src.add_argument("--trace", help="arrival-trace JSONL file")
+    src.add_argument("--generate", metavar="GENERATOR",
+                     help="synthesize a trace (traffic.GENERATORS)")
+    ap.add_argument("--tier", default="raw", help="raw|1m|10m (live mode)")
+    ap.add_argument("--window", type=float, default=None,
+                    help="seconds of history (live mode)")
+    ap.add_argument("--family", default=None,
+                    help="comma-separated family filter (live mode)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--duration", type=float, default=20.0)
+    ap.add_argument("--rate-scale", type=float, default=1.0)
+    ap.add_argument(
+        "--param", action="append", default=[], metavar="K=V",
+        help="extra generator kwarg (numeric), e.g. --param "
+        "backfill_sets=8 — the bench capacity_leg scales the ramp's "
+        "bulk floor to the measured capacity this way",
+    )
+    ap.add_argument("--cost-per-set", type=float, default=None,
+                    help="modeled serving cost, seconds per set")
+    ap.add_argument("--capacity-sets-per-sec", type=float, default=None,
+                    help="modeled capacity (overrides --cost-per-set)")
+    ap.add_argument("--shards", type=int, default=1)
+    ap.add_argument("--deadline-ms", type=float, default=25.0)
+    ap.add_argument("--slo-grace", type=float, default=2.0)
+    ap.add_argument("--step-s", type=float, default=0.25)
+    ap.add_argument("--headroom-alert", type=float, default=0.2)
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.url:
+        families = (
+            [f for f in args.family.split(",") if f]
+            if args.family else None
+        )
+        rep = live_report(
+            args.url, tier=args.tier, window_s=args.window,
+            families=families,
+        )
+        print(json.dumps(rep) if args.json else render_live(rep))
+        return 0
+
+    from lighthouse_tpu.verification_service import traffic
+
+    if args.trace:
+        _header, events = traffic.read_trace(args.trace)
+    else:
+        gen = traffic.GENERATORS.get(args.generate)
+        if gen is None:
+            raise SystemExit(
+                f"unknown generator {args.generate!r} "
+                f"(have: {', '.join(sorted(traffic.GENERATORS))})"
+            )
+        extra = {}
+        for kv in args.param:
+            k, _, v = kv.partition("=")
+            if not _:
+                raise SystemExit(f"malformed --param {kv!r} (want K=V)")
+            extra[k] = int(v) if v.lstrip("-").isdigit() else float(v)
+        events = gen(
+            duration_s=args.duration, seed=args.seed,
+            rate_scale=args.rate_scale, **extra,
+        )
+    if args.capacity_sets_per_sec is None and args.cost_per_set is None:
+        raise SystemExit(
+            "model mode needs --cost-per-set or --capacity-sets-per-sec"
+        )
+    rep = replay_estimator(
+        events,
+        cost_s_per_set=args.cost_per_set,
+        capacity_sets_per_sec=args.capacity_sets_per_sec,
+        shards=args.shards,
+        deadline_ms=args.deadline_ms,
+        slo_grace=args.slo_grace,
+        step_s=args.step_s,
+        headroom_alert=args.headroom_alert,
+    )
+    if args.json:
+        slim = {k: v for k, v in rep.items() if k != "timeline"}
+        slim["timeline_points"] = len(rep["timeline"])
+        print(json.dumps(slim))
+    else:
+        print(render_model(rep))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

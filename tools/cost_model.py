@@ -281,6 +281,56 @@ def main() -> None:
       "data-movement section; per-verify rows in the `transfer_ledger` "
       "journal events, which now carry an `indexed` flag).")
     w("")
+    # Capacity / headroom formula (ISSUE 14): the live dial the
+    # timeseries sampler serves, written here so the analytic model and
+    # the served estimator can never drift apart silently.
+    w("## Capacity & headroom formula (live estimator, ISSUE 14)")
+    w("")
+    w("The saturation dial served in `/lighthouse/health`'s `capacity` "
+      "block and at `/lighthouse/timeseries` "
+      "(`utils/timeseries.estimate_capacity`):")
+    w("")
+    w("```")
+    w("capacity_sets_per_sec = healthy_shards / cost_s_per_set")
+    w("utilization           = arrival_sets_per_sec / capacity_sets_per_sec")
+    w("headroom_ratio        = max(0, 1 - utilization)")
+    w("```")
+    w("")
+    w("Measured inputs, in preference order (the source is reported, "
+      "never fabricated):")
+    w("")
+    w("- `cost_s_per_set` — (1) Σ `bls_device_shard_verify_seconds` / "
+      "Σ `bls_device_shard_sets_total` over recent SAMPLING-INTERVAL "
+      "deltas (per-shard dispatch walls, current — a lifetime average "
+      "would mask what serving costs right now — so capacity scales "
+      "with the shard axis); (2) "
+      "`compile_service_measured_cost_seconds_per_set` (the organic "
+      "rung-cost feed `note_rung_verified` accumulates — per-rung "
+      "splits in the compile service status); (3) the pipeline "
+      "profiler's flush walls per fused set. The analytic counterpart "
+      "is the lanes/set tables above divided by the achieved MAC/s.")
+    w("- `healthy_shards` — `crypto/device/mesh.healthy_shard_count()` "
+      "live (falls back to the `verification_scheduler_dp_shards` "
+      "gauge; 1 when single-device).")
+    w("- `arrival_sets_per_sec` — the rated "
+      "`verification_scheduler_arrival_sets_total{kind,path}` counter "
+      "(submission-time accounting, so demand keeps climbing past "
+      "saturation instead of reading serving throughput back).")
+    w("")
+    w("The headroom dial is PREDICTIVE: on a `saturation_ramp` trace "
+      "it crosses below 0.2 while utilization is still under 1.0, and "
+      "the backlog integral needs further time to blow the SLO budget "
+      "— so the crossing and the `slo_burn` burn-rate alert both land "
+      "strictly before the first deadline-miss burst "
+      "(`tests/test_timeseries_capacity.py`; modeled offline by "
+      "`tools/capacity_report.py`, measured at the bench's headline "
+      "cost in the `capacity_leg`, `headroom_ratio` learned by "
+      "`tools/bench_diff.py`). This is the go/no-go input ROADMAP "
+      "item 2's bulk-QoS admission control reads — the committee "
+      "batch-verification cost model (arxiv 2302.00418) puts the "
+      "nonlinear throughput-vs-load regime exactly where the 1M-"
+      "validator firehose lives.")
+    w("")
     w("## Reading the table")
     w("")
     w("- The 50k agg/s target (150k sets/s, BASELINE.json) needs ~"
