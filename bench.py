@@ -906,6 +906,100 @@ def measure_chaos_leg(
     }
 
 
+def measure_bulk_leg(
+    use_cpu: bool,
+    seed: int = 9,
+    duration_s: float = 4.0,
+    time_scale: float = 0.5,
+    deadline_ms: float = 60.0,
+) -> dict:
+    """Bulk QoS class isolation (ISSUE 15): replay the
+    ``bulk_backfill_under_gossip`` composite vs its gossip-only
+    baseline — BYTE-IDENTICAL gossip arrivals by construction
+    (docs/TRAFFIC_REPLAY.md) — through a live scheduler with a stub
+    backend, each in a subprocess. Records gossip's worst-kind p99 and
+    miss ratio in both runs (``gossip_p99_under_bulk_ms`` is GATED by
+    ``tools/bench_diff.py`` — a growing number means the bulk class
+    started moving gossip's tail, the exact failure mode the class
+    exists to prevent) plus the bulk side's served throughput, sheds
+    and throttle excursions. Chunk size is pinned small for the stub
+    backend (one 512-set chunk's wall would rival the deadline here —
+    the documented head-of-line knob)."""
+    replay = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools",
+        "traffic_replay.py",
+    )
+    env = dict(os.environ)
+    if use_cpu:
+        env["JAX_PLATFORMS"] = "cpu"
+    env["LIGHTHOUSE_TPU_SCHED_BULK_FLUSH_SETS"] = "64"
+    env["LIGHTHOUSE_TPU_SCHED_BULK_LINGER_MS"] = "10"
+    reports = {}
+    for label, gen in (
+        ("baseline", "gossip_steady"),
+        ("bulk", "bulk_backfill_under_gossip"),
+    ):
+        leg_timeout = min(120.0, _budget_left() - 60)
+        if leg_timeout < 45:
+            return {"skipped": "budget"}
+        try:
+            r = subprocess.run(
+                [sys.executable, replay,
+                 "--generate", gen, "--seed", str(seed),
+                 "--duration", str(duration_s),
+                 "--time-scale", str(time_scale),
+                 "--deadline-ms", str(deadline_ms),
+                 "--workers", "96",
+                 "--verify", "stub:0.0002", "--json"],
+                capture_output=True, text=True, timeout=leg_timeout,
+                env=env,
+            )
+        except subprocess.TimeoutExpired:
+            return {"skipped": f"timeout>{leg_timeout:.0f}s"}
+        if r.returncode != 0:
+            return {"error": f"{label}: rc={r.returncode}: {r.stderr[-200:]}"}
+        try:
+            reports[label] = json.loads(r.stdout.strip().splitlines()[-1])
+        except (ValueError, IndexError):
+            return {"error": f"{label}: unparseable: {r.stdout[-200:]}"}
+
+    def worst_gossip(rep):
+        p99 = miss = 0.0
+        for kind in ("unaggregated", "aggregate", "sync_message"):
+            rec = rep["slo"]["kinds"].get(kind)
+            if rec:
+                p99 = max(p99, rec["p99_ms"])
+                miss = max(miss, rec["window_miss_ratio"])
+        return p99, miss
+
+    p99_0, miss_0 = worst_gossip(reports["baseline"])
+    p99_1, miss_1 = worst_gossip(reports["bulk"])
+    bulk_st = reports["bulk"]["scheduler"]["bulk"]
+    wall = reports["bulk"]["wall_s"]
+    return {
+        "generator": "bulk_backfill_under_gossip",
+        "seed": seed,
+        "time_scale": time_scale,
+        "deadline_ms": deadline_ms,
+        "verify_backend": reports["bulk"]["config"]["verify_backend"],
+        "gossip_p99_baseline_ms": p99_0,
+        "gossip_p99_under_bulk_ms": p99_1,
+        "gossip_p99_ratio": (
+            round(p99_1 / p99_0, 4) if p99_0 else None
+        ),
+        "gossip_miss_ratio_baseline": miss_0,
+        "gossip_miss_ratio_under_bulk": miss_1,
+        "bulk_sets_flushed": bulk_st["sets_flushed_total"],
+        "bulk_sets_per_sec": (
+            round(bulk_st["sets_flushed_total"] / wall, 2) if wall else None
+        ),
+        "bulk_flushes": bulk_st["flushes_total"],
+        "bulk_shed_total": bulk_st["shed_total"],
+        "throttle_excursions": bulk_st["admission"]["excursions_total"],
+        "verdicts": reports["bulk"]["verdicts"],
+    }
+
+
 def measure_dp_leg(
     n_sets: int = 16, reps: int = 3, messages: int = 2
 ) -> dict:
@@ -1393,6 +1487,17 @@ def main() -> None:
         except Exception as e:  # the leg must not kill the line
             chaos_leg = {"error": str(e)[:200]}
 
+    # Bulk-QoS isolation leg (ISSUE 15): gossip SLO under saturating
+    # backfill vs the gossip-only baseline + bulk sets/s — stub-backend
+    # subprocesses, seconds. gossip_p99_under_bulk_ms is GATED.
+    if _budget_left() < 120:
+        bulk_leg = {"skipped": "budget"}
+    else:
+        try:
+            bulk_leg = measure_bulk_leg(use_cpu)
+        except Exception as e:  # the leg must not kill the line
+            bulk_leg = {"error": str(e)[:200]}
+
     # Served multi-chip dp verify, 1 vs 2 virtual devices (ISSUE 11):
     # per-chip + aggregate sets/s through the real scheduler/planner/
     # backend stack. Subprocesses (XLA_FLAGS must precede jax init),
@@ -1493,6 +1598,7 @@ def main() -> None:
                 "replay_leg": replay_leg,
                 "capacity_leg": capacity_leg,
                 "chaos_leg": chaos_leg,
+                "bulk_leg": bulk_leg,
                 "dp_leg": dp_leg,
                 "startup": startup,
                 "buckets": buckets,

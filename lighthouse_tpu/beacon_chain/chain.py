@@ -422,11 +422,17 @@ class BeaconChain:
                 self.preset, self.spec, state, sb, fork_of(state),
                 signature_strategy="none",
             )
-        # segment import is sync-critical (the caller is blocked on the
-        # whole range): the scheduler bypass, never the fusing queue
-        from ..verification_service import backend_verify_now
+        # segment import is deadline-INSENSITIVE (the syncing caller is
+        # self-paced on the whole range): the bulk QoS class (ISSUE 15),
+        # which flushes at gossip idle onto the big warm rungs under
+        # admission control — a saturating range sync can no longer ride
+        # the synchronous verify_now bypass head-on against gossip's
+        # deadline class. Gossip block import keeps the bypass
+        # (block_verification.py): a proposal on the wire IS
+        # latency-critical.
+        from ..verification_service import backend_verify_bulk
 
-        if not backend_verify_now(self, all_sets, kind="chain_segment"):
+        if not backend_verify_bulk(self, all_sets, kind="chain_segment"):
             raise BlockError("InvalidSignature", "chain segment batch")
         return out
 

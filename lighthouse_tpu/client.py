@@ -53,6 +53,21 @@ class ClientConfig:
     # single-rung flush. None = LIGHTHOUSE_TPU_SCHED_PLANNER env
     # (default on); False pins the legacy plan.
     scheduler_plan_flushes: Optional[bool] = None
+    # bulk QoS class (verification_service/batcher.py + admission.py,
+    # ISSUE 15): chain-segment backfill / historical sync submit with
+    # qos="bulk" — a separate bounded queue flushed only at gossip idle
+    # onto the biggest warm rungs, paused by headroom-driven admission
+    # control (capacity_headroom_ratio below the floor, or a gossip
+    # slo_burn latch) and resumed with hysteresis. None = env knobs
+    # LIGHTHOUSE_TPU_SCHED_MAX_BULK_QUEUE (default 8192) /
+    # …_SCHED_BULK_FLUSH_SETS (512) / …_SCHED_BULK_LINGER_MS (100) /
+    # …_SCHED_BULK_HEADROOM_FLOOR (0.10) / …_SCHED_BULK_RESUME_HEADROOM
+    # (0.20).
+    scheduler_bulk_max_queue_sets: Optional[int] = None
+    scheduler_bulk_flush_sets: Optional[int] = None
+    scheduler_bulk_linger_ms: Optional[float] = None
+    scheduler_bulk_headroom_floor: Optional[float] = None
+    scheduler_bulk_resume_headroom: Optional[float] = None
     # AOT warmup + warm-shape routing + persistent executable caching for
     # the staged device pipeline (compile_service/); only effective with
     # bls_backend="tpu". None cache dir = LIGHTHOUSE_TPU_COMPILE_CACHE_DIR
@@ -483,12 +498,29 @@ class ClientBuilder:
             # sets fuse into shared device batches across callers
             from .verification_service import VerificationScheduler
 
+            bulk_admission = None
+            if (
+                cfg.scheduler_bulk_headroom_floor is not None
+                or cfg.scheduler_bulk_resume_headroom is not None
+            ):
+                # explicit admission thresholds: build the controller
+                # here; unset = the scheduler's own (env-tunable) one
+                from .verification_service import BulkAdmissionController
+
+                bulk_admission = BulkAdmissionController(
+                    floor=cfg.scheduler_bulk_headroom_floor,
+                    resume_headroom=cfg.scheduler_bulk_resume_headroom,
+                )
             chain.verification_scheduler = VerificationScheduler(
                 deadline_ms=cfg.scheduler_deadline_ms,
                 max_batch_sets=cfg.scheduler_max_batch_sets,
                 max_queue_sets=cfg.scheduler_max_queue_sets,
                 compile_service=csvc,
                 plan_flushes=cfg.scheduler_plan_flushes,
+                bulk_max_queue_sets=cfg.scheduler_bulk_max_queue_sets,
+                bulk_flush_sets=cfg.scheduler_bulk_flush_sets,
+                bulk_linger_ms=cfg.scheduler_bulk_linger_ms,
+                bulk_admission=bulk_admission,
             ).start()
 
         processor = _build_processor(chain, cfg.n_workers)

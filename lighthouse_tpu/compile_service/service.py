@@ -70,7 +70,19 @@ Rung = Tuple[int, int, int]  # (B, K, M) padded bucket shape
 # shapes, then the scheduler's large fused bucket and descending rungs
 # for trickle/single-set traffic. K=16/M=8 are the bench headline pads
 # (committee sets pad K up; the message-dedup plane rarely exceeds 8
-# uniques per flush).
+# uniques per flush). The BULK rungs (ISSUE 15) close the ladder at
+# LOWEST priority: B=512/256 is where the bulk QoS class drains
+# (bulk_flush_sets chunks — DP_SCALING.json measures the best sets/s
+# at B=256/512, exactly where the committee cost model's batching
+# gains peak) — gossip's headline rungs must all be warm before the
+# AOT walk spends minutes on backfill's. Their geometry is the REAL
+# wired bulk callers' (chain-segment import + checkpoint backfill =
+# proposal signatures: K=1, one DISTINCT message per set, so M pads
+# to B — an M=8 rung could never cover a drain whose unique-message
+# count scales with its set count); committee-carrying bulk ingest
+# (slasher-style, K>1) re-bins onto whatever warm coverage exists or
+# sheds to the fallback until an operator adds its rung via
+# LIGHTHOUSE_TPU_COMPILE_RUNGS.
 DEFAULT_RUNGS: Tuple[Rung, ...] = (
     (64, 16, 8),
     (48, 16, 8),
@@ -82,6 +94,8 @@ DEFAULT_RUNGS: Tuple[Rung, ...] = (
     (192, 16, 8),
     (4, 16, 8),
     (1, 16, 8),
+    (512, 1, 512),
+    (256, 1, 256),
 )
 
 _ENV_ENABLED = "LIGHTHOUSE_TPU_COMPILE_SERVICE"

@@ -679,7 +679,6 @@ class BackfillSync:
     def run(self, peer: Peer) -> int:
         """Blocking backfill from the oldest stored block downwards.
         Returns the number of blocks stored."""
-        from ..crypto import bls
         from ..store.iter import block_roots_iter
 
         chain = self.service.chain
@@ -725,7 +724,14 @@ class BackfillSync:
                 want = bytes(sb.message.parent_root)
             if not verified:
                 return stored
-            if sets and not bls.verify_signature_sets(sets):
+            # historical proposal signatures are the textbook bulk-class
+            # workload (ISSUE 15): deadline-insensitive, contiguous,
+            # self-paced — the scheduler fuses them onto the big warm
+            # rungs at gossip idle; without a scheduler this is the same
+            # direct call as before
+            from ..verification_service import backend_verify_bulk
+
+            if sets and not backend_verify_bulk(chain, sets, kind="backfill"):
                 return stored
             for root, sb in verified:
                 chain.store.put_block(root, sb)

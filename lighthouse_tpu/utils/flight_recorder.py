@@ -58,6 +58,8 @@ EVENT_KINDS = (
     "attestation_rejected",   # beacon_chain/attestation_verification.py
     "block_rejected",         # beacon_chain/block_verification.py
     "bls_stage_verify",       # crypto/device/bls.py, one per staged verify
+    "bulk_resume",            # verification_service/admission.py, excursion end
+    "bulk_throttle",          # verification_service/admission.py, bulk paused
     "cold_route",             # compile_service/service.py, cold-bucket flush
     "compile_failed",         # compile_service/service.py, per failed rung
     "compile_ready",          # compile_service/service.py, rung now warm

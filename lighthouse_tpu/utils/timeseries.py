@@ -118,6 +118,16 @@ class FamilySpec:
 SAMPLE_FAMILIES: Tuple[FamilySpec, ...] = (
     FamilySpec("capacity_arrival_sets_per_sec", "rate",
                "verification_scheduler_arrival_sets_total", "kind"),
+    # bulk QoS class (ISSUE 15): queue depth + served rate + the
+    # admission throttle state — the three series an operator reads to
+    # see the degradation order doing its job (bulk sheds FIRST as
+    # headroom vanishes; gossip's series above stay flat)
+    FamilySpec("capacity_bulk_queue_depth", "gauge",
+               "verification_scheduler_bulk_queue_depth", None),
+    FamilySpec("capacity_bulk_sets_per_sec", "rate",
+               "verification_scheduler_bulk_sets_total", "kind"),
+    FamilySpec("capacity_bulk_throttled", "gauge",
+               "verification_scheduler_bulk_throttled", None),
     FamilySpec("capacity_deadline_miss_per_sec", "rate",
                "verification_scheduler_deadline_misses_total", "kind"),
     FamilySpec("capacity_device_memory_bytes", "gauge",
@@ -161,11 +171,13 @@ _EST_CAPACITY = metrics.gauge(
 )
 _UTILIZATION = metrics.gauge(
     "capacity_utilization",
-    "measured arrival rate (capacity_arrival_sets_per_sec summed over "
-    "kinds) / estimated capacity: < 1 means headroom exists, > 1 means "
-    "the queue is growing and deadline misses are a matter of time — "
-    "the nonlinear-regime dial of the committee batch-verification "
-    "cost model (arxiv 2302.00418)",
+    "measured demand (deadline-class arrival rate + ADMITTED bulk "
+    "service rate — parked bulk demand is excluded so the admission "
+    "valve never throttles on demand it itself controls, ISSUE 15) / "
+    "estimated capacity: < 1 means headroom exists, > 1 means the "
+    "queue is growing and deadline misses are a matter of time — the "
+    "nonlinear-regime dial of the committee batch-verification cost "
+    "model (arxiv 2302.00418)",
 )
 _HEADROOM = metrics.gauge(
     "capacity_headroom_ratio",
@@ -733,6 +745,32 @@ def estimate_capacity(
 # ---------------------------------------------------------------------------
 
 
+def _bulk_arrival_rate(now: float) -> float:
+    """Bulk-PATH arrival rate (sets/s) off the same counter the arrival
+    series samples, grouped by the path label instead of kind. NOT
+    stored as a series — it exists only to be subtracted from the
+    estimator's utilization numerator (see ``sample()``). First
+    sighting rates 0.0 (no interval yet): the numerator momentarily
+    includes bulk demand rather than fabricating a subtraction.
+    Called under ``_state_lock`` like every `_rate_state` user."""
+    vals = _source_values(
+        "verification_scheduler_arrival_sets_total", "path"
+    )
+    value = (vals or {}).get("bulk")
+    if value is None:
+        return 0.0
+    key = ("_util_bulk_arrivals", "bulk")
+    prev = _rate_state.get(key)
+    _rate_state[key] = (now, value)
+    if prev is None:
+        return 0.0
+    t0, v0 = prev
+    dt = now - t0
+    if dt <= 0:
+        return 0.0
+    return max(0.0, value - v0) / dt
+
+
 def sample(now: Optional[float] = None) -> Optional[dict]:
     """Run ONE sampling pass: snapshot every allowlisted family into
     the store, then run the capacity estimator on the rates just
@@ -746,6 +784,7 @@ def sample(now: Optional[float] = None) -> Optional[dict]:
         now = time.time()
     store = get_store()
     arrival_total: Optional[float] = None
+    bulk_served = 0.0
     with _state_lock:
         for spec in SAMPLE_FAMILIES:
             if spec.mode == "gauge":
@@ -758,9 +797,26 @@ def sample(now: Optional[float] = None) -> Optional[dict]:
                 rates = _sample_rates(spec, store, now)
                 if spec.family == "capacity_arrival_sets_per_sec" and rates:
                     arrival_total = sum(rates.values())
+                elif spec.family == "capacity_bulk_sets_per_sec" and rates:
+                    bulk_served = sum(rates.values())
             elif spec.mode == "ratio":
                 _sample_bubble_ratio(spec, store, now)
             # "derived" families are recorded below by the estimator
+        # primed EVERY pass (not only when the arrival series already
+        # rated) so its own first sighting lines up with the arrival
+        # family's — a lazily-primed read would miss the first real
+        # interval's bulk demand
+        bulk_demand = _bulk_arrival_rate(now)
+        if arrival_total is not None:
+            # the utilization NUMERATOR counts deadline-class demand
+            # plus ADMITTED bulk service — not raw bulk offered demand
+            # (ISSUE 15): bulk arrivals the admission valve has parked
+            # would otherwise hold headroom below the resume threshold
+            # on demand the valve itself controls, a self-referential
+            # feedback loop that could never un-throttle under a
+            # persistent bulk submitter. The per-kind arrival SERIES
+            # keeps the full demand picture (bulk included).
+            arrival_total = max(0.0, arrival_total - bulk_demand) + bulk_served
         _update_interval_shard_cost()
     est = estimate_capacity(arrival_sets_per_sec=arrival_total)
     if est["estimated_sets_per_sec"] is not None:

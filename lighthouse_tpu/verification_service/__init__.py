@@ -9,10 +9,12 @@ into kind-homogeneous, bin-packed sub-batches when that reduces padded
 device lanes, falling back to the legacy single-rung flush when it
 cannot win."""
 
+from .admission import BulkAdmissionController
 from .batcher import (
     BUCKET_LADDER,
     VerificationScheduler,
     backend_verify,
+    backend_verify_bulk,
     backend_verify_each,
     backend_verify_now,
     round_up_bucket,
@@ -32,12 +34,14 @@ from .slo import SloTracker
 
 __all__ = [
     "BUCKET_LADDER",
+    "BulkAdmissionController",
     "FlushPlan",
     "FlushPlanner",
     "PlannedSubBatch",
     "SloTracker",
     "VerificationScheduler",
     "backend_verify",
+    "backend_verify_bulk",
     "backend_verify_each",
     "backend_verify_now",
     "flush_geometry",

@@ -45,6 +45,34 @@ without bound.
 Latency-critical callers (block verification) use :meth:`verify_now`,
 a counted synchronous bypass that never waits on a deadline.
 
+Bulk QoS class (ISSUE 15): ``submit(sets, kind, qos="bulk")`` queues
+deadline-INSENSITIVE work — chain-segment backfill, historical sync,
+slasher-style ingest — on a SEPARATE bounded queue that the flush
+thread services only when the deadline class is idle (the gossip queue
+is empty and no gossip trigger is due), draining up to
+``bulk_flush_sets`` (default 512) at a time so the planner packs it
+onto the largest warm rungs (B=256/512 — where DP_SCALING.json shows
+the best sets/s, exactly where the committee batch-verification cost
+model says batching gains are largest for this class). A bulk flush
+NEVER preempts gossip: the trigger priority is shutdown > explicit >
+full > deadline > bulk, and a trickle of bulk lingers
+``bulk_linger_ms`` (default 100) to accumulate a big batch instead of
+shredding the rung ladder. Admission is governed by
+:class:`.admission.BulkAdmissionController`: when the live
+``capacity_headroom_ratio`` drops below its floor or a gossip kind's
+SLO burn alert latches, bulk flushing and admission PAUSE (one
+``bulk_throttle`` journal event per excursion) and resume with
+hysteresis (``bulk_resume``). Overflow of the bulk queue degrades the
+submission to its CALLER's thread — the self-paced pre-scheduler
+behavior — never to gossip's flush thread. Bulk verdicts feed the SLO
+surface under their own class (path ``bulk`` / ``bulk_shed``,
+``qos="bulk"``): quantiles are visible, but they can neither miss a
+deadline nor dilute gossip's burn windows (slo.py). The robustness
+contract: under ANY bulk load, gossip's verdict-latency SLO is
+indistinguishable from the no-bulk baseline, and losing headroom sheds
+bulk first, gracefully, with full observability
+(``tests/test_bulk_qos.py``).
+
 Verdict-latency SLO (ISSUE 7): every submission's end-to-end
 submit→future-resolution latency is measured on EVERY resolution path —
 ``fused`` (single-rung flush), ``sub_batch`` (planned split), ``bisection``
@@ -136,6 +164,7 @@ from ..utils import (
     tracing,
     transfer_ledger,
 )
+from .admission import BulkAdmissionController
 from .slo import SloTracker
 
 # Mirrors crypto/device/bls._round_up's choices without importing the
@@ -217,7 +246,13 @@ _QUEUE_DEPTH = metrics.gauge(
 )
 _QUEUE_WAIT = metrics.histogram(
     "verification_scheduler_queue_wait_seconds",
-    "submit-to-dispatch wait per submission (bounded by the deadline)",
+    "submit-to-dispatch wait per DEADLINE-class submission (bounded by "
+    "the deadline) — bulk submissions are excluded (ISSUE 15): a bulk "
+    "wait spans linger + gossip-busy windows + throttle excursions by "
+    "design and would explode this histogram's tail while gossip is "
+    "perfectly healthy; bulk wait is visible in "
+    "verification_scheduler_verdict_latency_seconds{path=bulk} and the "
+    "bulk queue-depth gauge",
 )
 _BISECTIONS = metrics.counter(
     "verification_scheduler_bisections_total",
@@ -265,9 +300,10 @@ _VERDICT_LATENCY = metrics.histogram_vec(
     "resolution path: fused (single-rung flush), sub_batch (planned "
     "split), bisection (split-and-retry leaf), shed (backpressure "
     "caller-thread fallback), bypass (verify_now), fallback "
-    "(compile-service CPU-native shed), empty (immediate False) — the "
-    "submitter-experienced latency the SLO layer certifies "
-    "(docs/TRAFFIC_REPLAY.md)",
+    "(compile-service CPU-native shed), empty (immediate False), bulk "
+    "(bulk-class idle-time flush), bulk_shed (bulk-queue overflow "
+    "degraded to the caller's thread) — the submitter-experienced "
+    "latency the SLO layer certifies (docs/TRAFFIC_REPLAY.md)",
     ("kind", "path"),
 )
 _DP_SHARDS = metrics.gauge(
@@ -312,6 +348,33 @@ _ARRIVALS = metrics.counter_vec(
     "(ISSUE 14)",
     ("kind", "path"),
 )
+_BULK_QUEUE_DEPTH = metrics.gauge(
+    "verification_scheduler_bulk_queue_depth",
+    "signature sets queued in the bulk QoS class awaiting an idle-time "
+    "flush (ISSUE 15) — bounded by the bulk queue knob; overflow "
+    "degrades to the caller's thread, so this gauge can saturate but "
+    "never grow without bound. The deadline class's queue is "
+    "verification_scheduler_queue_depth",
+)
+_BULK_SETS = metrics.counter_vec(
+    "verification_scheduler_bulk_sets_total",
+    "signature sets SERVED by the bulk class per caller kind: queued "
+    "drains counted at flush time, overflow sheds counted when their "
+    "caller-thread verify resolves (shed bulk is still bulk service — "
+    "the capacity estimator's utilization numerator must see it). With "
+    "verification_scheduler_sets_total (flushed, both classes) this "
+    "splits served throughput by QoS class; the capacity sampler "
+    "rates it into capacity_bulk_sets_per_sec",
+    ("kind",),
+)
+_BULK_SHED = metrics.counter_vec(
+    "verification_scheduler_bulk_shed_total",
+    "bulk submissions degraded to synchronous verification in their "
+    "CALLER's thread on bulk-queue overflow (the documented degradation "
+    "order: bulk sheds first, self-paced, never onto gossip's flush "
+    "thread)",
+    ("kind",),
+)
 _DEADLINE_MISSES = metrics.counter_vec(
     "verification_scheduler_deadline_misses_total",
     "submissions whose verdict landed after the SLO budget (slo_grace x "
@@ -348,11 +411,12 @@ class WatchdogTimeout(RuntimeError):
 
 
 class _Submission:
-    __slots__ = ("kind", "sets", "future", "submitted_at")
+    __slots__ = ("kind", "sets", "future", "submitted_at", "qos")
 
-    def __init__(self, kind: str, sets: List):
+    def __init__(self, kind: str, sets: List, qos: str = "deadline"):
         self.kind = kind
         self.sets = sets
+        self.qos = qos
         self.future: Future = Future()
         self.submitted_at = time.monotonic()
 
@@ -375,6 +439,10 @@ class VerificationScheduler:
         slo_grace: float | None = None,
         watchdog_s: float | None = None,
         watchdog_bypass_s: float | None = None,
+        bulk_max_queue_sets: int | None = None,
+        bulk_flush_sets: int | None = None,
+        bulk_linger_ms: float | None = None,
+        bulk_admission: Optional[BulkAdmissionController] = None,
     ):
         self._verify = verify_fn or bls.verify_signature_sets
         # warm-shape router (compile_service/service.py); None = every
@@ -431,10 +499,42 @@ class VerificationScheduler:
             else _env_float("LIGHTHOUSE_TPU_SCHED_WATCHDOG_BYPASS_S", 0.0)
         )
         self._watchdog_reaped = 0
+        # bulk QoS class (ISSUE 15; module docstring): a second bounded
+        # queue serviced only when the deadline class is idle, drained
+        # in big-rung chunks, governed by the admission controller
+        self.bulk_max_queue_sets = int(
+            bulk_max_queue_sets
+            if bulk_max_queue_sets is not None
+            else _env_int("LIGHTHOUSE_TPU_SCHED_MAX_BULK_QUEUE", 8192)
+        )
+        self.bulk_flush_sets = max(1, int(
+            bulk_flush_sets
+            if bulk_flush_sets is not None
+            else _env_int("LIGHTHOUSE_TPU_SCHED_BULK_FLUSH_SETS", 512)
+        ))
+        self.bulk_linger_s = max(0.0, (
+            bulk_linger_ms
+            if bulk_linger_ms is not None
+            else _env_float("LIGHTHOUSE_TPU_SCHED_BULK_LINGER_MS", 100.0)
+        ) / 1000.0)
+        # while throttled the flush thread re-polls admission at this
+        # cadence instead of parking forever (resume is time-driven:
+        # the latch expiry and the headroom dial move without a wake)
+        self._bulk_recheck_s = 0.25
+        self._admission = (
+            bulk_admission
+            if bulk_admission is not None
+            else BulkAdmissionController()
+        )
+        self._bulk_flushes = 0
+        self._bulk_sets_flushed = 0
+        self._bulk_shed = 0
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._pending: deque[_Submission] = deque()
         self._pending_sets = 0
+        self._bulk_pending: deque[_Submission] = deque()
+        self._bulk_pending_sets = 0
         self._flush_requested = False
         self._stopped = True  # not accepting until start()
         self._thread: Optional[threading.Thread] = None
@@ -453,6 +553,10 @@ class VerificationScheduler:
         # the process-global cumulative histograms); the tracker also
         # owns the lifetime miss totals — one source of truth
         self._slo = SloTracker()
+        # the admission controller's burn-latch read is THIS scheduler's
+        # tracker (an injected controller may already carry its own)
+        if self._admission.tracker is None:
+            self._admission.tracker = self._slo
 
     # -- lifecycle --------------------------------------------------------
 
@@ -487,10 +591,18 @@ class VerificationScheduler:
 
     # -- submission -------------------------------------------------------
 
-    def submit(self, sets, kind: str) -> Future:
+    def submit(self, sets, kind: str, qos: str = "deadline") -> Future:
         """Queue one caller's signature sets for fused verification.
         Returns a Future resolving to the same bool a direct
-        ``bls.verify_signature_sets(sets)`` call would return."""
+        ``bls.verify_signature_sets(sets)`` call would return.
+        ``qos="bulk"`` routes deadline-insensitive work (chain-segment
+        backfill, slasher ingest) onto the bulk class — idle-time
+        big-rung flushes under admission control (module docstring) —
+        with the same verdict-identity contract."""
+        if qos not in ("deadline", "bulk"):
+            raise ValueError(f"unknown qos class {qos!r}")
+        if qos == "bulk":
+            return self._submit_bulk(sets, kind)
         sub = _Submission(kind, list(sets))
         if not sub.sets:
             # matches verify_signature_sets([]) == False; must not join a
@@ -536,26 +648,100 @@ class VerificationScheduler:
                 bound=self.max_queue_sets,
                 running=self.running(),
             )
-            with tracing.span(
-                "scheduler.shed_fallback", kind=kind, n_sets=len(sub.sets)
-            ):
-                # leaf resolution in the caller's thread: verdict, outcome
-                # accounting and exception delivery all match the direct
-                # call this submission degraded to. Cold-rung protection
-                # applies HERE too — a backpressure shed must not block a
-                # gossip caller on an XLA compile either.
-                verify = None
-                path = "shed"
-                svc = self._compile_service
-                if svc is not None and svc.active():
-                    decision = svc.decide_flush(
-                        sub.sets, caller=f"shed:{kind}"
-                    )
-                    if decision["action"] == "shed":
-                        verify = svc.fallback_verify
-                        path = "fallback"
-                self._resolve_group([sub], verify, path=path)
+            self._shed_resolve(
+                sub, "scheduler.shed_fallback", f"shed:{kind}", "shed"
+            )
         return sub.future
+
+    def _submit_bulk(self, sets, kind: str) -> Future:
+        """Bulk-class admission (ISSUE 15): enqueue on the bounded bulk
+        queue — serviced only at deadline-class idle — or, on overflow
+        (or a stopped scheduler), degrade to a synchronous verify in
+        the CALLER's thread: the self-paced pre-scheduler behavior,
+        identical verdict, never a burden on gossip's flush thread."""
+        sub = _Submission(kind, list(sets), qos="bulk")
+        if not sub.sets:
+            self._finish(sub, False, path="empty")
+            return sub.future
+        _ARRIVALS.with_labels(kind, "bulk").inc(len(sub.sets))
+        # drive the throttle latch from the arrival side too, FORCED
+        # past the evaluator's rate limit: the first bulk submission
+        # after headroom collapses must journal the bulk_throttle
+        # BEFORE any of its sets could queue — the ordering the
+        # acceptance gate pins (throttle precedes the miss burst, not
+        # the other way around) — and a rate-limited read would return
+        # the stale pre-collapse state for arrivals landing within
+        # min_interval_s of the flush loop's last evaluation. The
+        # result is deliberately NOT cached: admission state is read
+        # fresh off the controller's latch everywhere (a cached flag
+        # written from two threads could overwrite a fresh throttle
+        # with a stale admitted and let one chunk flush mid-excursion)
+        self._admission.evaluate(force=True)
+        shed = False
+        with self._cv:
+            if self._stopped:
+                shed = True
+            elif (
+                self._bulk_pending
+                and self._bulk_pending_sets + len(sub.sets)
+                > self.bulk_max_queue_sets
+            ):
+                # overflow sheds to the caller's thread; an oversized
+                # submission on an EMPTY bulk queue is accepted (same
+                # live-lock rule as the deadline queue)
+                shed = True
+            if shed:
+                self._bulk_shed += 1
+            else:
+                self._bulk_pending.append(sub)
+                self._bulk_pending_sets += len(sub.sets)
+                _BULK_QUEUE_DEPTH.set(self._bulk_pending_sets)
+                # wake the flush thread: it must (re)arm the bulk
+                # linger/full timer (a gossip-idle thread may be parked
+                # with no deadline armed at all)
+                self._cv.notify()
+        if shed:
+            _BULK_SHED.with_labels(kind).inc()
+            flight_recorder.record(
+                "scheduler_shed",
+                kind=kind,
+                qos="bulk",
+                n_sets=len(sub.sets),
+                queue_sets=self._bulk_pending_sets,
+                bound=self.bulk_max_queue_sets,
+                running=self.running(),
+            )
+            self._shed_resolve(
+                sub, "scheduler.bulk_shed", f"bulk_shed:{kind}", "bulk_shed"
+            )
+            # shed bulk IS bulk service (verified in the caller's
+            # thread, possibly on the device): counted into the served
+            # family so the capacity estimator's utilization numerator
+            # (timeseries.sample) sees the work — an uncounted shed
+            # stream would let headroom over-read exactly while the
+            # device is busiest with it
+            _BULK_SETS.with_labels(kind).inc(len(sub.sets))
+        return sub.future
+
+    def _shed_resolve(
+        self, sub: "_Submission", span_name: str, caller: str, path: str,
+    ) -> None:
+        """ONE shed rule for both QoS classes: leaf resolution in the
+        CALLER's thread — verdict, outcome accounting and exception
+        delivery all match the direct call the submission degraded to.
+        Cold-rung protection applies to EVERY shed path: a degraded
+        caller must never block minutes on an XLA compile (the
+        compile-service fallback serves it instead, relabeling the
+        resolution path)."""
+        with tracing.span(span_name, kind=sub.kind, n_sets=len(sub.sets)):
+            verify = None
+            svc = self._compile_service
+            if svc is not None and svc.active():
+                decision = svc.decide_flush(sub.sets, caller=caller)
+                if decision["action"] == "shed":
+                    verify = svc.fallback_verify
+                    path = "fallback"
+            self._resolve_group([sub], verify, path=path)
 
     def verify_now(self, sets, kind: str = "block") -> bool:
         """Synchronous bypass for latency-critical callers: identical to
@@ -647,8 +833,29 @@ class VerificationScheduler:
             return None
         return self._pending[0].submitted_at + self.deadline_s
 
+    def _bulk_due_locked(self, now: float) -> Optional[float]:
+        """The time the bulk queue becomes eligible to flush — ``now``
+        once a full big-rung chunk is pending, else the oldest bulk
+        submission's linger expiry; None when the queue is empty or
+        admission is paused. Called under the lock; bulk eligibility
+        additionally requires the deadline class to be idle (the
+        caller checks ``self._pending`` — never preempt)."""
+        if not self._bulk_pending or self._admission.throttled():
+            return None
+        if self._bulk_pending_sets >= self.bulk_flush_sets:
+            return now
+        return self._bulk_pending[0].submitted_at + self.bulk_linger_s
+
     def _loop(self) -> None:
         while True:
+            # admission DRIVEN outside the cv (it reads the capacity
+            # estimator and may journal a transition); the lock-held
+            # due computation reads the controller's latch directly —
+            # never a cached flag (see _submit_bulk)
+            if self._bulk_pending_sets or self._admission.throttled():
+                self._admission.evaluate()
+            trigger = None
+            bulk = False
             with self._cv:
                 while True:
                     if self._stopped:
@@ -665,64 +872,137 @@ class VerificationScheduler:
                     if deadline is not None and now >= deadline:
                         trigger = "deadline"
                         break
+                    # bulk services ONLY at deadline-class idle (never
+                    # preempts), and only while admitted
+                    bulk_due = self._bulk_due_locked(now)
+                    if (
+                        not self._pending
+                        and bulk_due is not None
+                        and now >= bulk_due
+                    ):
+                        trigger = "bulk"
+                        bulk = True
+                        break
+                    waits = []
+                    if deadline is not None:
+                        waits.append(deadline - now)
+                    if bulk_due is not None and not self._pending:
+                        waits.append(bulk_due - now)
+                    if self._bulk_pending and self._admission.throttled():
+                        # throttled with bulk waiting: the resume signal
+                        # (latch expiry, headroom recovery) moves without
+                        # a notify — re-poll instead of parking forever
+                        waits.append(self._bulk_recheck_s)
                     # pipeline profiler (ISSUE 12): an empty-queue wait
                     # is the `queue_empty` bubble cause — a device gap
                     # overlapping it is traffic's fault, not the
-                    # pipeline's (timed only when the queue is empty;
-                    # a deadline-armed wait has work pending). Opened
-                    # EAGERLY: a verify_now gap closing while this
-                    # thread is still parked must see the wait.
+                    # pipeline's (timed only when the DEADLINE queue is
+                    # empty; a deadline-armed wait has work pending —
+                    # parked bulk is idle by design, not a bubble).
+                    # Opened EAGERLY: a verify_now gap closing while
+                    # this thread is still parked must see the wait.
                     idle_t0 = (
                         time.perf_counter() if not self._pending else None
                     )
                     if idle_t0 is not None:
                         pipeline_profiler.note_idle_begin(idle_t0)
-                    self._cv.wait(
-                        None if deadline is None else deadline - now
-                    )
+                    self._cv.wait(min(waits) if waits else None)
                     if idle_t0 is not None:
                         pipeline_profiler.note_idle_end(
                             idle_t0, time.perf_counter()
                         )
-                subs = self._drain_locked()
+                    if self._bulk_pending and self._admission.throttled():
+                        # re-evaluate admission outside the lock before
+                        # the next wait round
+                        break
+                if trigger is None:
+                    continue  # admission recheck wake
+                if bulk:
+                    subs = self._drain_bulk_locked()
+                else:
+                    subs = self._drain_locked()
+                    if trigger == "shutdown" and not subs:
+                        # the shutdown drain covers BOTH classes: gossip
+                        # first (priority holds to the end), then bulk
+                        # in big-rung chunks until empty — admission
+                        # cannot veto the drain contract (every queued
+                        # future resolves)
+                        subs = self._drain_bulk_locked()
+                        bulk = bool(subs)
                 self._flush_requested = False
                 stopped = self._stopped
             if subs:
-                self._flush_batch(subs, trigger)
+                self._flush_batch(
+                    subs, trigger, qos="bulk" if bulk else "deadline"
+                )
             elif stopped:
                 return
 
-    def _drain_locked(self) -> List[_Submission]:
-        """Take at most one bucket's worth of submissions (whole
-        submissions only — a submission is the isolation unit and never
-        splits across fused batches). Called under the lock."""
+    @staticmethod
+    def _drain_from(queue, cap: int) -> List[_Submission]:
+        """ONE drain rule for both QoS classes: take at most ``cap``
+        sets off ``queue`` in whole submissions (a submission is the
+        isolation unit and never splits across fused batches; the
+        first submission is always taken so an oversized one cannot
+        live-lock). Called under the lock."""
         subs: List[_Submission] = []
         n = 0
-        while self._pending:
-            nxt = self._pending[0]
-            if subs and n + len(nxt.sets) > self.max_batch_sets:
+        while queue:
+            nxt = queue[0]
+            if subs and n + len(nxt.sets) > cap:
                 break
-            subs.append(self._pending.popleft())
+            subs.append(queue.popleft())
             n += len(nxt.sets)
-        self._pending_sets -= n
+        return subs
+
+    def _drain_locked(self) -> List[_Submission]:
+        """One bucket's worth off the deadline queue (under the lock)."""
+        subs = self._drain_from(self._pending, self.max_batch_sets)
+        self._pending_sets -= sum(len(s.sets) for s in subs)
         _QUEUE_DEPTH.set(self._pending_sets)
         return subs
 
-    def _flush_batch(self, subs: List[_Submission], trigger: str) -> None:
+    def _drain_bulk_locked(self) -> List[_Submission]:
+        """One big-rung chunk (``bulk_flush_sets``) off the bulk queue
+        (under the lock)."""
+        subs = self._drain_from(self._bulk_pending, self.bulk_flush_sets)
+        self._bulk_pending_sets -= sum(len(s.sets) for s in subs)
+        _BULK_QUEUE_DEPTH.set(self._bulk_pending_sets)
+        return subs
+
+    def _flush_batch(
+        self, subs: List[_Submission], trigger: str, qos: str = "deadline",
+    ) -> None:
         n_sets = sum(len(s.sets) for s in subs)
         kinds_mix = "+".join(sorted({s.kind for s in subs}))
         now = time.monotonic()
         for s in subs:
-            _QUEUE_WAIT.observe(now - s.submitted_at)
+            if qos != "bulk":
+                # bulk waits (linger + gossip-busy windows + throttle
+                # excursions) are the class contract, not queue latency
+                # — they'd pollute the deadline-class histogram's tail
+                _QUEUE_WAIT.observe(now - s.submitted_at)
             _SETS_TOTAL.with_labels(s.kind).inc(len(s.sets))
+            if qos == "bulk":
+                _BULK_SETS.with_labels(s.kind).inc(len(s.sets))
+        if qos == "bulk":
+            self._bulk_flushes += 1
+            self._bulk_sets_flushed += n_sets
         # pipeline profiler (ISSUE 12): one lifecycle record per flush —
         # queue-wait (the oldest submission's), plan, pack, device and
         # fallback walls accumulate from this thread and the dp workers
         # (flush_scope below), and flush_end journals ONE pipeline_flush
-        # event with the critical-path split (None when disabled)
+        # event with the critical-path split (None when disabled). A
+        # bulk flush reports queue-wait 0: its wait (linger +
+        # gossip-busy windows + whole throttle excursions) is the class
+        # contract, and one post-excursion flush would otherwise swamp
+        # the deadline-class flush_phase_seconds{queue_wait} signal —
+        # the same pollution the _QUEUE_WAIT exclusion above prevents
         prec = pipeline_profiler.flush_begin(
             trigger=trigger, kinds=kinds_mix, n_submissions=len(subs),
-            n_sets=n_sets, queue_wait_s=now - subs[0].submitted_at,
+            n_sets=n_sets, queue_wait_s=(
+                0.0 if qos == "bulk" else now - subs[0].submitted_at
+            ),
         )
         svc = self._compile_service
         if svc is not None and not svc.active():
@@ -751,7 +1031,9 @@ class VerificationScheduler:
             except Exception:
                 warm = None
         t_plan = time.perf_counter()
-        plan = self._planner.plan(subs, warm_rungs=warm, shards=shards)
+        plan = self._planner.plan(
+            subs, warm_rungs=warm, shards=shards, qos=qos
+        )
         pipeline_profiler.note_plan_wall(
             t_plan, time.perf_counter(), record=prec
         )
@@ -784,6 +1066,7 @@ class VerificationScheduler:
         with tracing.span(
             "scheduler.flush",
             trigger=trigger,
+            qos=qos,
             kinds=kinds_mix,
             n_submissions=len(subs),
             n_sets=n_sets,
@@ -799,7 +1082,7 @@ class VerificationScheduler:
                 with pipeline_profiler.flush_scope(prec):
                     try:
                         results[idx] = self._dispatch_sub_batch(
-                            sb, svc, mesh, plan.mode, trigger
+                            sb, svc, mesh, plan.mode, trigger, qos
                         )
                     except BaseException as e:  # noqa: BLE001 — futures first
                         # a worker must NEVER strand its futures: whatever
@@ -859,6 +1142,7 @@ class VerificationScheduler:
         flight_recorder.record(
             "scheduler_plan",
             mode=plan.mode,
+            qos=qos,
             n_submissions=len(subs),
             n_sets=n_sets,
             n_sub_batches=len(plan.sub_batches),
@@ -878,6 +1162,7 @@ class VerificationScheduler:
         flight_recorder.record(
             "scheduler_flush",
             trigger=trigger,
+            qos=qos,
             kinds=kinds_mix,
             n_submissions=len(subs),
             n_sets=n_sets,
@@ -895,7 +1180,8 @@ class VerificationScheduler:
     # -- sub-batch dispatch (the dp x rung plan element) ------------------
 
     def _dispatch_sub_batch(
-        self, sb, svc, mesh, plan_mode: str, trigger: str
+        self, sb, svc, mesh, plan_mode: str, trigger: str,
+        qos: str = "deadline",
     ) -> dict:
         """Execute ONE plan element: route it (cold-bucket protection per
         element — a sub-batch whose padded rung has no compiled staged
@@ -940,10 +1226,14 @@ class VerificationScheduler:
             _PLAN_LANES.with_labels("padded").inc(paid)
         # SLO path label: the compile-service CPU fallback is its own
         # resolution path (its latency profile is nothing like a device
-        # dispatch); otherwise a planned split resolves via sub_batch, a
-        # single-rung flush via fused
+        # dispatch); a BULK flush resolves under its class's own label
+        # (idle-time latency is the class contract, not a tail to hide
+        # among gossip's); otherwise a planned split resolves via
+        # sub_batch, a single-rung flush via fused
         if route_action == "shed":
             path = "fallback"
+        elif qos == "bulk":
+            path = "bulk"
         elif plan_mode == "planned":
             path = "sub_batch"
         else:
@@ -1180,19 +1470,25 @@ class VerificationScheduler:
 
     def _account(self, sub: _Submission, path: str) -> None:
         """One submission resolved: its end-to-end latency feeds the SLO
-        surface exactly once, on whatever path delivered the verdict."""
+        surface exactly once, on whatever path delivered the verdict —
+        under the submission's own QoS class, so a bisected or shed bulk
+        submission stays bulk-class on every leaf."""
         self._observe_latency(
             sub.kind, path, time.monotonic() - sub.submitted_at,
-            len(sub.sets),
+            len(sub.sets), qos=sub.qos,
         )
 
     def _observe_latency(
-        self, kind: str, path: str, latency_s: float, n_sets: int
+        self, kind: str, path: str, latency_s: float, n_sets: int,
+        qos: str = "deadline",
     ) -> None:
         budget_s = self.deadline_s * self.slo_grace
-        missed = latency_s > budget_s
+        # a bulk verdict is deadline-insensitive BY CONTRACT: it cannot
+        # miss (its latency is the idle-time wait the class signed up
+        # for) and must not reach the burn buckets either way (slo.py)
+        missed = qos == "deadline" and latency_s > budget_s
         _VERDICT_LATENCY.with_labels(kind, path).observe(latency_s)
-        self._slo.observe(kind, path, latency_s, missed)
+        self._slo.observe(kind, path, latency_s, missed, qos=qos)
         if missed:
             _DEADLINE_MISSES.with_labels(kind).inc()
             flight_recorder.record(
@@ -1226,11 +1522,28 @@ class VerificationScheduler:
         with self._lock:
             pending_subs = len(self._pending)
             pending_sets = self._pending_sets
+            bulk_subs = len(self._bulk_pending)
+            bulk_sets = self._bulk_pending_sets
         mesh = _active_mesh()  # read the seam ONCE: stop() may null it
         return {
             "running": self.running(),
             "queue_submissions": pending_subs,
             "queue_sets": pending_sets,
+            # the bulk QoS class (ISSUE 15): per-class queue depth,
+            # flush/shed totals and the live admission/throttle state —
+            # the health rows an operator reads to see WHY backfill is
+            # paused while gossip is fine
+            "bulk": {
+                "queue_submissions": bulk_subs,
+                "queue_sets": bulk_sets,
+                "max_queue_sets": self.bulk_max_queue_sets,
+                "flush_sets": self.bulk_flush_sets,
+                "linger_ms": round(self.bulk_linger_s * 1000.0, 3),
+                "flushes_total": self._bulk_flushes,
+                "sets_flushed_total": self._bulk_sets_flushed,
+                "shed_total": self._bulk_shed,
+                "admission": self._admission.status(),
+            },
             "deadline_misses_total": self._slo.misses_total(),
             "max_batch_sets": self.max_batch_sets,
             "max_queue_sets": self.max_queue_sets,
@@ -1298,3 +1611,39 @@ def backend_verify_now(chain, sets, kind: str = "block") -> bool:
     if sched is None:
         return bls.verify_signature_sets(sets)
     return sched.verify_now(sets, kind)
+
+
+def backend_verify_bulk(chain, sets, kind: str) -> bool:
+    """Deadline-insensitive callers (chain-segment backfill, historical
+    sync, slasher ingest): the scheduler's BULK class when attached —
+    idle-time big-rung flushes under admission control, so a saturating
+    backfill can never move gossip's p99 — else the direct call. The
+    caller blocks on the verdict either way (segment import is
+    sequential by nature, which is exactly the self-pacing the
+    degradation order relies on). Verdict identical to a direct
+    ``bls.verify_signature_sets(sets)``.
+
+    A big segment is CHUNKED into ``bulk_flush_sets``-sized
+    submissions here: submissions are atomic (the isolation unit never
+    splits) and the drain always takes the first submission whole, so
+    one multi-thousand-set submission would flush as one batch and
+    occupy the flush thread for the segment's entire verify wall —
+    breaking the documented head-of-line bound (a gossip arrival waits
+    at most ONE in-flight bulk chunk). All chunks are submitted before
+    any result is awaited (they fuse/pipeline at gossip idle), every
+    future is consumed, and the all() verdict matches the single batch
+    call's."""
+    sched = scheduler_of(chain)
+    if sched is None:
+        return bls.verify_signature_sets(sets)
+    sets = list(sets)
+    if not sets:
+        # matches verify_signature_sets([]) == False via the
+        # scheduler's empty-submission path
+        return sched.submit(sets, kind, qos="bulk").result()
+    chunk = max(1, int(sched.bulk_flush_sets))
+    futs = [
+        sched.submit(sets[i:i + chunk], kind, qos="bulk")
+        for i in range(0, len(sets), chunk)
+    ]
+    return all([f.result() for f in futs])

@@ -54,6 +54,23 @@ sub-batches:
   concurrently; wall-clock is the max, not the sum) plus the dispatch
   overhead charge against the legacy single rung.
 
+* **class-aware packing** (ISSUE 15) — ``plan(..., qos="bulk")`` packs
+  a BULK-class flush (chain-segment backfill, slasher ingest —
+  ``batcher.py``'s second service class) for throughput, not latency:
+  the batcher drains bulk in big-rung chunks (``bulk_flush_sets``,
+  default 512), so bulk bins naturally fill the largest ladder rungs
+  (B=256/512 — where DP_SCALING.json measures the best sets/s and the
+  committee cost model's batching gains peak, PAPERS.md arxiv
+  2302.00418); when the exact big rung is COLD but smaller warm rungs
+  cover the group's (K, M), a bulk bin RE-BINS into chunks of the
+  largest covering warm rung instead of shedding hundreds of sets to
+  the CPU fallback (a deadline-class flush never does this — splitting
+  a latency-class flush multiplies its dispatch count on the critical
+  path; bulk has no deadline, only throughput); and the dp floor rises
+  to :data:`BULK_DP_MIN_SETS` so bulk never shreds below a
+  big-rung-worth per shard. The deadline class's plan is byte-identical
+  to pre-ISSUE-15 (pinned by ``tests/test_bulk_qos.py``).
+
 Submissions are ATOMIC: a submission is the verdict-isolation unit
 (split-and-retry bisection, batcher.py) and is never split across
 sub-batches — every plan covers every submission exactly once, and the
@@ -90,6 +107,11 @@ DEFAULT_SUBBATCH_OVERHEAD_LANES = 16
 # kind group smaller than 2x this stays on one shard (trickle keeps
 # fusing; the shard axis is for the big warm rungs, DP_SCALING.json).
 DEFAULT_DP_MIN_SETS = 8
+# Bulk-class dp floor (ISSUE 15): a bulk flush exists to fill the big
+# rungs, so a shard is only worth waking for a big-rung-worth of sets —
+# below this the deadline-class floor would shred a 512-set drain into
+# dispatch-overhead-dominated slivers across chips.
+BULK_DP_MIN_SETS = 64
 _ENV_OVERHEAD = "LIGHTHOUSE_TPU_SCHED_PLAN_OVERHEAD_LANES"
 _ENV_PLANNER = "LIGHTHOUSE_TPU_SCHED_PLANNER"
 _ENV_DP_MIN = "LIGHTHOUSE_TPU_SCHED_DP_MIN_SETS"
@@ -330,6 +352,7 @@ class FlushPlanner:
         subs: Sequence,
         warm_rungs=None,
         shards: Optional[Sequence[int]] = None,
+        qos: str = "deadline",
     ) -> FlushPlan:
         """Partition ``subs`` (objects with ``.kind`` and ``.sets``) into
         sub-batches. ``warm_rungs`` is the compile-service registry's
@@ -339,7 +362,11 @@ class FlushPlanner:
         no service attached (every exact rung dispatches; the packers
         pad to it). ``shards`` is the mesh's healthy shard-id list —
         more than one enables the dp packing axis; None/1 is the
-        single-device behavior, byte-identical to before."""
+        single-device behavior, byte-identical to before. ``qos`` is
+        the flush's service class (ISSUE 15, module docstring): bulk
+        plans fill the largest warm rungs and re-bin cold big rungs
+        onto warm coverage; the deadline class is unchanged."""
+        bulk = qos == "bulk"
         shard_ids = [int(s) for s in shards] if shards else []
         dp = len(shard_ids) > 1
         warm = warm_rungs
@@ -366,7 +393,7 @@ class FlushPlanner:
         # accounting and failover behave uniformly (dp scoring below
         # only engages at width > 1)
         planned = self._kind_binpacked(
-            subs, flags, warm, table, shard_ids or None
+            subs, flags, warm, table, shard_ids or None, bulk=bulk
         )
         if len(planned) <= 1:
             # one bin == the legacy plan re-derived; report it as single
@@ -384,6 +411,15 @@ class FlushPlanner:
             if planned_cold and not legacy.cold:
                 return FlushPlan("single", [legacy], legacy.rung, legacy.cold)
             if legacy.cold and not planned_cold:
+                return FlushPlan("planned", planned, legacy.rung, legacy.cold)
+            if bulk and legacy.cold and any(not sb.cold for sb in planned):
+                # bulk partial-warm salvage (ISSUE 15): when the single
+                # rung is cold, a split that gets ANY share onto warm
+                # device rungs beats shedding the whole drain to the CPU
+                # fallback — the lane score below cannot see the
+                # device/CPU cliff (a shed pays CPU wall, not lanes).
+                # Deadline-class flushes never take this: a partial shed
+                # still stalls the latency class on its slowest member.
                 return FlushPlan("planned", planned, legacy.rung, legacy.cold)
         # static/dynamic separation dominates the lane score (ISSUE 10):
         # when the split isolates key-table-resident sub-batches from
@@ -502,6 +538,7 @@ class FlushPlanner:
     def _kind_binpacked(
         self, subs: List, flags: List[bool], warm,
         table=None, shards: Optional[List[int]] = None,
+        bulk: bool = False,
     ) -> List[PlannedSubBatch]:
         """Sub-bucket by kind — and, with a device key table attached,
         by static/dynamic eligibility (``flags``, one per submission,
@@ -526,7 +563,14 @@ class FlushPlanner:
             n_group = sum(len(s.sets) for s in members)
             if shards:
                 parts = self._dp_partition(
-                    members, n_group, shards, shard_load
+                    members, n_group, shards, shard_load,
+                    # bulk never shreds below a big-rung-worth per
+                    # shard (ISSUE 15): parallelism is for the big
+                    # warm rungs, not for slivers
+                    dp_min=(
+                        max(self.dp_min_sets, BULK_DP_MIN_SETS)
+                        if bulk else self.dp_min_sets
+                    ),
                 )
             else:
                 parts = [(None, members)]
@@ -556,26 +600,102 @@ class FlushPlanner:
                         # own bin
                         bins.append([[sub], size])
                 for members_bin, _count in bins:
-                    planned.append(
-                        self._make_sub_batch(
-                            members_bin, shard_warm, table,
-                            static=_static, shard=shard,
-                        )
+                    sb = self._make_sub_batch(
+                        members_bin, shard_warm, table,
+                        static=_static, shard=shard,
                     )
+                    if bulk and sb.cold and shard_warm:
+                        # bulk fills warm rungs (ISSUE 15): a cold big
+                        # rung re-bins onto warm coverage instead of
+                        # shedding the drain to the CPU fallback
+                        planned.extend(self._bulk_warm_rebin(
+                            sb, shard_warm, table, _static, shard,
+                        ))
+                    else:
+                        planned.append(sb)
         return planned
+
+    def _bulk_warm_rebin(
+        self, sb: PlannedSubBatch, warm: List[Rung], table,
+        static: bool, shard: Optional[int],
+    ) -> List[PlannedSubBatch]:
+        """Bulk-class cold-rung salvage (ISSUE 15): ``sb``'s exact big
+        rung has no compiled program, but smaller warm rungs may cover
+        its (K, M) — re-bin the submissions into chunks of the LARGEST
+        covering warm B, so a 512-set backfill drain fills two warm
+        256-rungs on device instead of shedding the lot to the CPU
+        fallback. The deadline class never does this: splitting a
+        latency-class flush multiplies dispatches on the critical path,
+        while bulk pays wall-clock it is contractually indifferent to.
+        Submissions stay atomic — one larger than every covering warm
+        rung keeps its own (cold) bin, and decide_flush sheds exactly
+        that remainder, not the whole drain.
+
+        Coverage is judged per CHUNK, not against the whole batch's
+        m_req: each set carries one message (``_geometry_of``), so a
+        chunk's unique-message count is bounded by its set count — a
+        warm (256,1,256) rung serves 256-set chunks of a 512-set
+        per-set-distinct-message drain (m_req=512) that could never
+        cover the batch whole. A cap below :data:`BULK_DP_MIN_SETS`
+        is not worth re-binning for (a big drain would shred into
+        dispatch-overhead-dominated slivers): keep the cold bin."""
+        cap = 0
+        for r in warm:
+            if r[1] < sb.k_req:
+                continue
+            # the rung serves chunks up to its B outright when its M
+            # plane covers min(B, batch m_req); else chunks up to its
+            # M (a chunk of c sets has at most c unique messages)
+            cap = max(cap, (
+                r[0] if r[2] >= min(sb.m_req, r[0]) else min(r[0], r[2])
+            ))
+        if cap < BULK_DP_MIN_SETS:
+            return [sb]
+        if cap >= sb.n_sets:
+            # a covering rung existed after all — sb would not be cold;
+            # defensive: keep the original bin
+            return [sb]
+        bins: List[List] = []
+        order = sorted(
+            range(len(sb.subs)), key=lambda i: (-len(sb.subs[i].sets), i)
+        )
+        for i in order:
+            sub = sb.subs[i]
+            size = len(sub.sets)
+            placed = False
+            for b in bins:
+                if b[1] + size <= cap:
+                    b[0].append(sub)
+                    b[1] += size
+                    placed = True
+                    break
+            if not placed:
+                bins.append([[sub], size])
+        if len(bins) <= 1:
+            return [sb]
+        return [
+            self._make_sub_batch(
+                members, warm, table, static=static, shard=shard
+            )
+            for members, _count in bins
+        ]
 
     def _dp_partition(
         self, members: List, n_group: int, shards: List[int],
-        shard_load: Dict[int, int],
+        shard_load: Dict[int, int], dp_min: Optional[int] = None,
     ) -> List[Tuple[int, List]]:
         """Partition one kind group's submissions across dp shards:
-        at most ``n_group // dp_min_sets`` shards participate (a shard
-        must be worth its dispatch overhead), chosen least-loaded
+        at most ``n_group // dp_min`` shards participate (a shard
+        must be worth its dispatch overhead; ``dp_min`` defaults to
+        the deadline class's ``dp_min_sets`` — bulk raises it to
+        :data:`BULK_DP_MIN_SETS`), chosen least-loaded
         first; big submissions greedily land on the least-loaded chosen
         shard. Deterministic (sorted, index tie-breaks) — the lockstep
         replay's byte-identical-across-processes gate covers dp plans
         too. Submissions NEVER split across shards."""
-        k = min(len(shards), max(1, n_group // self.dp_min_sets))
+        if dp_min is None:
+            dp_min = self.dp_min_sets
+        k = min(len(shards), max(1, n_group // dp_min))
         if k <= 1:
             s = min(shards, key=lambda i: (shard_load[i], i))
             shard_load[s] += n_group
@@ -597,7 +717,7 @@ class FlushPlanner:
         # no dispatch is ever worth less than the floor the knob
         # documents. Terminates: every merge removes a bucket.
         while len(buckets) > 1:
-            under = [s for s in buckets if local[s] < self.dp_min_sets]
+            under = [s for s in buckets if local[s] < dp_min]
             if not under:
                 break
             s = min(under, key=lambda j: (local[j], j))

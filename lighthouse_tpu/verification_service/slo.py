@@ -33,14 +33,33 @@ live burn rates in ``verification_scheduler_slo_burn_rate{kind,window}``
 (``utils/timeseries.py``) and ROADMAP item 2's admission control build
 on.
 
+**Per-class tracking (ISSUE 15).** The scheduler now serves two QoS
+classes (``deadline`` — gossip's latency class — and ``bulk`` —
+chain-segment backfill and slasher-style ingest, docs/
+VERIFICATION_SERVICE.md). A bulk verdict is deadline-INSENSITIVE by
+contract: it never counts as a miss, and — the part that matters — its
+samples must not enter the burn-window DENOMINATOR either, where a
+saturating backfill's thousands of on-time verdicts would dilute
+gossip's miss ratio and silence the very alert that is supposed to
+shed the backfill. ``observe(..., qos=...)`` therefore records bulk
+samples into the quantile window (operators still get bulk p50/p99)
+but skips the burn buckets entirely; ``summary()`` labels each kind
+with its class (STICKY-deadline: one deadline-class sample upgrades a
+kind for good, so a mixed-class kind's bulk samples can never hide an
+active gossip burn excursion), and ``latched_kinds()`` — the admission
+controller's read — can only ever name deadline-class kinds.
+
 **Ratio-scope fix (ISSUE 14 satellite).** ``misses_total`` /
 ``count_total`` are LIFETIME counters and the window numbers are
 window-scoped — after long uptimes the two diverge, and a reader mixing
 a lifetime numerator with a windowed denominator gets a meaningless
 ratio. ``summary()`` now reports both scopes explicitly:
-``window_miss_ratio`` (window misses / window count, as before) AND
+``window_miss_ratio`` (window misses / window count) AND
 ``lifetime_miss_ratio`` (lifetime misses / lifetime count), so no
-consumer has to derive a ratio across scopes.
+consumer has to derive a ratio across scopes. Both denominators count
+DEADLINE-class samples only (ISSUE 15): misses are deadline-only by
+construction, so a mixed-class kind's saturating bulk stream would
+otherwise dilute either ratio toward zero during a live miss storm.
 
 Deliberately **jax-free** and scheduler-instance-scoped: a replay run or
 a test reads ITS scheduler's window (``summary()``/``burn()``), not the
@@ -95,8 +114,7 @@ _ENV_FAST = "LIGHTHOUSE_TPU_SLO_FAST_S"
 _ENV_SLOW = "LIGHTHOUSE_TPU_SLO_SLOW_S"
 _ENV_ALERT = "LIGHTHOUSE_TPU_SLO_BURN_ALERT"
 
-# (t, latency_seconds, path, missed)
-_Sample = Tuple[float, float, str, bool]
+_Sample = Tuple[float, float, str, bool, str]  # (t, s, path, missed, qos)
 
 _BURN_RATE = metrics.gauge_vec(
     "verification_scheduler_slo_burn_rate",
@@ -179,7 +197,19 @@ class SloTracker:
         self._lock = threading.Lock()
         self._samples: Dict[str, Deque[_Sample]] = {}
         self._count_total: Dict[str, int] = {}
+        # lifetime DEADLINE-class sample count per kind: the
+        # lifetime_miss_ratio denominator (misses are deadline-only by
+        # construction, so the all-class count would dilute a mixed
+        # kind's ratio exactly like the window fix below prevents)
+        self._dl_count_total: Dict[str, int] = {}
         self._misses_total: Dict[str, int] = {}
+        # kind -> QoS class label (ISSUE 15), STICKY-deadline: "bulk"
+        # only while every sample the kind ever carried was bulk — one
+        # deadline sample upgrades it for good (a mixed-class kind's
+        # deadline samples keep feeding the burn buckets, so its burn
+        # doc must stay visible). The summary label + the guarantee
+        # that latched_kinds() only ever names deadline-class kinds.
+        self._kind_qos: Dict[str, str] = {}
         # burn accounting is TIME-bucketed, decoupled from the
         # count-bounded quantile deque: at production verdict rates
         # (hundreds/s) 1024 samples span seconds, which would silently
@@ -204,12 +234,16 @@ class SloTracker:
 
     def observe(
         self, kind: str, path: str, seconds: float, missed: bool,
-        now: float | None = None,
+        now: float | None = None, qos: str = "deadline",
     ) -> None:
         """Record one resolved submission: end-to-end latency, the
         resolution path that produced the verdict, and whether it landed
         past the deadline. ``now`` is injectable for deterministic
-        burn-window tests (default ``time.monotonic()``)."""
+        burn-window tests (default ``time.monotonic()``). ``qos`` is the
+        submission's service class: a non-deadline sample feeds the
+        quantile window only — never the burn buckets, whose count
+        denominator a saturating bulk stream would otherwise dilute
+        (module docstring, ISSUE 15)."""
         if now is None:
             now = time.monotonic()
         check_burn = False
@@ -218,11 +252,22 @@ class SloTracker:
             if dq is None:
                 dq = self._samples[kind] = deque(maxlen=self.window)
                 self._count_total[kind] = 0
+                self._dl_count_total[kind] = 0
                 self._misses_total[kind] = 0
-            dq.append((now, seconds, path, missed))
+            # sticky-deadline: a kind that EVER carried deadline-class
+            # samples keeps its burn visibility — last-writer-wins
+            # would let one bulk sample of a mixed-class kind hide an
+            # ACTIVE gossip burn excursion from burn()/summary()
+            if qos == "deadline" or kind not in self._kind_qos:
+                self._kind_qos[kind] = qos
+            dq.append((now, seconds, path, missed, qos))
             self._count_total[kind] += 1
+            if qos == "deadline":
+                self._dl_count_total[kind] += 1
             if missed:
                 self._misses_total[kind] += 1
+            if qos != "deadline":
+                return
             buckets = self._burn_buckets.get(kind)
             if buckets is None:
                 buckets = self._burn_buckets[kind] = deque(
@@ -357,6 +402,21 @@ class SloTracker:
                 slow_burn=doc["slow"]["burn"],
             )
 
+    def latched_kinds(self, now: float | None = None) -> list:
+        """Kinds whose burn-alert latch is live: a confirmed ``slo_burn``
+        excursion within the fast window. THE standing-alert read the
+        bulk admission controller polls (ISSUE 15) — bulk-class samples
+        never reach the burn buckets, so any latched kind is by
+        construction a deadline-class (gossip) kind."""
+        if now is None:
+            now = time.monotonic()
+        with self._lock:
+            return sorted(
+                kind
+                for kind, at in self._burn_alerted_at.items()
+                if at is not None and now - at <= self.fast_window_s
+            )
+
     def burn(self, now: float | None = None) -> dict:
         """The miss-budget burn document: per kind, miss ratio and burn
         multiple over the fast and slow windows, the alert latch state
@@ -366,9 +426,14 @@ class SloTracker:
         if now is None:
             now = time.monotonic()
         with self._lock:
+            # bulk-class kinds (ISSUE 15) never feed the burn buckets —
+            # an all-zero doc for them would read as "zero burn
+            # measured" rather than "not applicable", so they are
+            # absent here exactly as their summary() burn block is None
             kinds = {
                 kind: self._burn_kind_locked(kind, now)
                 for kind in sorted(self._samples)
+                if self._kind_qos.get(kind, "deadline") == "deadline"
             }
         for kind, doc in kinds.items():
             self._publish_burn_gauges(kind, doc)
@@ -403,10 +468,16 @@ class SloTracker:
         with self._lock:
             snap = {k: list(dq) for k, dq in self._samples.items()}
             counts = dict(self._count_total)
+            dl_counts = dict(self._dl_count_total)
             misses = dict(self._misses_total)
+            kind_qos = dict(self._kind_qos)
+            # deadline-class kinds only (burn()'s filter): computing a
+            # bulk kind's burn doc here would be lock-held work whose
+            # result the "burn" key below discards anyway
             burn_kinds = {
                 kind: self._burn_kind_locked(kind, now)
                 for kind in sorted(self._samples)
+                if kind_qos.get(kind, "deadline") == "deadline"
             }
         for kind, bdoc in burn_kinds.items():
             self._publish_burn_gauges(kind, bdoc)
@@ -414,6 +485,14 @@ class SloTracker:
         for kind in sorted(snap):
             samples = snap[kind]
             lat = sorted(s[1] for s in samples)
+            # the windowed miss ratio is DEADLINE-scoped (ISSUE 15): a
+            # mixed-class kind's saturating bulk stream would otherwise
+            # pack the shared window with never-miss samples and read
+            # near-zero during an active gossip miss storm — the exact
+            # dilution the burn buckets already refuse. Quantiles stay
+            # all-class (bulk visibility is the feature; the per-path
+            # rows below separate the classes for mixed kinds).
+            dl_count = sum(1 for s in samples if s[4] == "deadline")
             window_misses = sum(1 for s in samples if s[3])
             paths = {}
             for path in sorted({s[2] for s in samples}):
@@ -424,6 +503,10 @@ class SloTracker:
                     "p99_ms": quantile_ms(plat, 0.99),
                 }
             kinds[kind] = {
+                # the QoS class this kind's samples carry (ISSUE 15):
+                # bulk kinds report quantiles but no burn block — their
+                # misses are defined away, not hidden
+                "qos": kind_qos.get(kind, "deadline"),
                 "count_total": counts[kind],
                 "window_count": len(samples),
                 "p50_ms": quantile_ms(lat, 0.50),
@@ -432,17 +515,26 @@ class SloTracker:
                 "misses_total": misses[kind],
                 "window_misses": window_misses,
                 "window_miss_ratio": (
-                    round(window_misses / len(samples), 4) if samples else 0.0
+                    round(window_misses / dl_count, 4) if dl_count else 0.0
                 ),
                 # explicitly lifetime-scoped (ISSUE 14 satellite): the
-                # lifetime numerator over the lifetime denominator — a
-                # reader never has to divide across scopes
+                # lifetime numerator over the lifetime DEADLINE-class
+                # denominator (ISSUE 15) — a reader never has to divide
+                # across scopes, and a mixed kind's bulk samples cannot
+                # dilute it
                 "lifetime_miss_ratio": (
-                    round(misses[kind] / counts[kind], 6)
-                    if counts[kind] else 0.0
+                    round(misses[kind] / dl_counts.get(kind, 0), 6)
+                    if dl_counts.get(kind) else 0.0
                 ),
                 "paths": paths,
-                "burn": burn_kinds.get(kind),
+                # bulk kinds carry no burn block: their samples never
+                # enter the burn buckets, so the empty doc would read
+                # as "zero burn measured" rather than "not applicable"
+                "burn": (
+                    burn_kinds.get(kind)
+                    if kind_qos.get(kind, "deadline") == "deadline"
+                    else None
+                ),
             }
         doc = {
             "window": self.window,

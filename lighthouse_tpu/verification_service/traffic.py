@@ -45,7 +45,13 @@ TRACE_VERSION = 1
 TRACE_SCHEMA = f"lighthouse_tpu.traffic_trace/{TRACE_VERSION}"
 
 _PATHS = ("submit", "verify_now")
-_EVENT_DEFAULTS = {"pubkeys": 1, "messages": 1, "path": "submit"}
+# QoS service classes (ISSUE 15): "deadline" = gossip's latency class,
+# "bulk" = the deadline-insensitive backfill/ingest class (submit-path
+# only — the verify_now bypass IS the latency-critical escape hatch)
+_QOS = ("deadline", "bulk")
+_EVENT_DEFAULTS = {
+    "pubkeys": 1, "messages": 1, "path": "submit", "qos": "deadline",
+}
 
 
 # ---------------------------------------------------------------------------
@@ -73,6 +79,16 @@ def _validate_event(ev: dict, lineno: int) -> dict:
         raise ValueError(
             f"trace line {lineno}: unknown path {out['path']!r} "
             f"(expected one of {_PATHS})"
+        )
+    if out["qos"] not in _QOS:
+        raise ValueError(
+            f"trace line {lineno}: unknown qos {out['qos']!r} "
+            f"(expected one of {_QOS})"
+        )
+    if out["qos"] == "bulk" and out["path"] != "submit":
+        raise ValueError(
+            f"trace line {lineno}: qos=bulk is submit-only (the "
+            f"verify_now bypass is the latency-critical class)"
         )
     return out
 
@@ -423,6 +439,56 @@ def saturation_ramp(
     return _finish(evs)
 
 
+def bulk_backfill_under_gossip(
+    duration_s: float = 12.0,
+    seed: int = 0,
+    rate_scale: float = 1.0,
+    committee: int = 8,
+    bulk_start_frac: float = 0.25,
+    bulk_every_s: float = 0.4,
+    bulk_sets: Tuple[int, ...] = (96, 128, 192),
+) -> List[dict]:
+    """The ISSUE 15 acceptance shape (ROADMAP item 2 names it): FULL
+    gossip steady-state — the same three Poisson streams as
+    ``gossip_steady`` with the same seed derivation, so a gossip-only
+    baseline run of ``gossip_steady(duration_s, seed, rate_scale,
+    committee)`` carries byte-identical gossip arrivals — plus a
+    SATURATING bulk stream (``qos="bulk"``, kind ``backfill``): large
+    contiguous chain-segment submissions every ``bulk_every_s`` from
+    ``bulk_start_frac * duration_s`` onward, offering far more sets/s
+    than any deadline-class box serves. The leading bulk-free window is
+    the within-trace control; the robustness contract under replay is
+    that gossip's per-kind p99 and deadline-miss ratio in the bulk
+    window are indistinguishable from the baseline run, bulk drains at
+    gossip idle onto the big rungs, and the admission controller
+    journals ``bulk_throttle`` before any gossip miss burst."""
+    rng = random.Random(seed)
+    # IDENTICAL gossip arrivals to gossip_steady(seed): same helper,
+    # same derived seed — the isolation test depends on this equality
+    evs = gossip_steady(
+        duration_s=duration_s, seed=seed, rate_scale=rate_scale,
+        committee=committee,
+    )
+    t = bulk_start_frac * duration_s
+    while t < duration_s:
+        n = rng.choice(bulk_sets)
+        evs.append({
+            # the REAL wired bulk callers' geometry (chain-segment
+            # import + checkpoint backfill verify proposal signatures):
+            # K=1, one DISTINCT message per set — exactly the shape the
+            # bulk AOT rungs (512,1,512)/(256,1,256) serve, so a warm
+            # staged replay of this trace exercises the big-rung drain
+            # path instead of shedding every bulk flush to the CPU
+            # fallback (a committee-carrying K=8/M=n//8 shape could
+            # never route to the shipped bulk rungs)
+            "t": round(t, 6), "kind": "backfill", "n_sets": int(n),
+            "pubkeys": 1, "messages": int(n),
+            "path": "submit", "qos": "bulk",
+        })
+        t += bulk_every_s * rng.uniform(0.8, 1.2)
+    return _finish(evs)
+
+
 # Generator catalogue: every entry documented in docs/TRAFFIC_REPLAY.md
 # (linted by tests/test_zgate4_metrics_lint.py).
 GENERATORS: Dict[str, Callable[..., List[dict]]] = {
@@ -431,6 +497,7 @@ GENERATORS: Dict[str, Callable[..., List[dict]]] = {
     "sync_committee_period": sync_committee_period,
     "bulk_backfill": bulk_backfill,
     "saturation_ramp": saturation_ramp,
+    "bulk_backfill_under_gossip": bulk_backfill_under_gossip,
 }
 
 
@@ -458,41 +525,68 @@ def lockstep_replay(
     planner: Optional[FlushPlanner] = None,
     warm_rungs: Optional[list] = None,
     shards: Optional[list] = None,
+    bulk_flush_sets: int = 512,
+    bulk_linger_ms: float = 100.0,
 ) -> dict:
     """Deterministic virtual replay: walk the trace in arrival order and
     apply the scheduler's EXACT drain/flush policy (deadline measured
     from the oldest pending submission; bucket-full at
     ``max_batch_sets``; whole-submission drains; shutdown drain at the
     end) with the shape-aware planner deciding every flush — no
-    threads, no wall clock, no jax. The returned report (submission
-    sequence, per-flush plan shapes, per-kind set counts, and a sha256
-    digest over all of it) is a pure function of (trace, parameters):
-    the determinism property ``tests/test_traffic_replay.py`` pins
-    across processes."""
+    threads, no wall clock, no jax. ``qos="bulk"`` events (ISSUE 15)
+    enqueue on the modeled bulk queue, which drains in
+    ``bulk_flush_sets`` chunks ONLY while the deadline queue is idle —
+    full chunks immediately at idle, partial ones after
+    ``bulk_linger_ms`` — mirroring the batcher's never-preempt trigger
+    priority (admission control is live-signal-driven and deliberately
+    NOT modeled: headroom needs a wall clock). The returned report
+    (submission sequence, per-flush plan shapes, per-kind set counts,
+    and a sha256 digest over all of it) is a pure function of (trace,
+    parameters): the determinism property
+    ``tests/test_traffic_replay.py`` pins across processes."""
     planner = planner or FlushPlanner()
     deadline_s = deadline_ms / 1000.0
+    bulk_linger_s = bulk_linger_ms / 1000.0
     pending: deque = deque()  # (ReplaySubmission, arrival t)
     pending_sets = 0
+    bulk_pending: deque = deque()  # (ReplaySubmission, arrival t)
+    bulk_pending_sets = 0
+    # the virtual arrival time at which the bulk queue last crossed the
+    # full-chunk threshold (None while below): a full chunk's idle-time
+    # drain is due from that moment, not from the oldest arrival
+    bulk_full_at: Optional[float] = None
     submissions: List[list] = []
     bypasses: List[list] = []
     flushes: List[dict] = []
     set_totals: Dict[str, int] = {}
+    bulk_set_total = 0
 
-    def drain_one(trigger: str) -> None:
-        nonlocal pending_sets
+    def drain_one(trigger: str, qos: str = "deadline") -> None:
+        nonlocal pending_sets, bulk_pending_sets, bulk_full_at
+        bulk = qos == "bulk"
+        queue = bulk_pending if bulk else pending
+        cap = bulk_flush_sets if bulk else max_batch_sets
         subs: List[ReplaySubmission] = []
         n = 0
-        while pending:
-            nxt, _t = pending[0]
-            if subs and n + len(nxt.sets) > max_batch_sets:
+        while queue:
+            nxt, _t = queue[0]
+            if subs and n + len(nxt.sets) > cap:
                 break
-            sub, _t = pending.popleft()
+            sub, _t = queue.popleft()
             subs.append(sub)
             n += len(sub.sets)
-        pending_sets -= n
-        plan = planner.plan(subs, warm_rungs=warm_rungs, shards=shards)
+        if bulk:
+            bulk_pending_sets -= n
+            if bulk_pending_sets < bulk_flush_sets:
+                bulk_full_at = None
+        else:
+            pending_sets -= n
+        plan = planner.plan(
+            subs, warm_rungs=warm_rungs, shards=shards, qos=qos
+        )
         flushes.append({
             "trigger": trigger,
+            "qos": qos,
             "n_submissions": len(subs),
             "n_sets": n,
             "mode": plan.mode,
@@ -519,12 +613,31 @@ def lockstep_replay(
             ],
         })
 
+    def advance_to(t_limit: float) -> None:
+        """Run every drain due strictly before ``t_limit``, in virtual-
+        time order: gossip deadline drains first; bulk drains only in
+        the windows where the deadline queue is empty (the batcher's
+        never-preempt rule — a gossip submission PARKS bulk until its
+        own deadline passes)."""
+        while True:
+            if pending:
+                td = pending[0][1] + deadline_s
+                if td <= t_limit:
+                    drain_one("deadline")
+                    continue
+                return  # gossip pending blocks bulk past t_limit
+            if bulk_pending:
+                if bulk_full_at is not None:
+                    tb = bulk_full_at
+                else:
+                    tb = bulk_pending[0][1] + bulk_linger_s
+                if tb <= t_limit:
+                    drain_one("bulk", qos="bulk")
+                    continue
+            return
+
     for ev in sorted(events, key=lambda e: e["t"]):
-        # deadline flushes due BEFORE this arrival (each drain takes one
-        # bucket-worth, then the condition re-evaluates — the loop shape
-        # of VerificationScheduler._loop)
-        while pending and pending[0][1] + deadline_s <= ev["t"]:
-            drain_one("deadline")
+        advance_to(ev["t"])
         if ev["path"] == "verify_now":
             bypasses.append([ev["kind"], ev["n_sets"]])
             set_totals[ev["kind"]] = (
@@ -534,23 +647,42 @@ def lockstep_replay(
         sets = synthetic_sets(
             ev["kind"], ev["n_sets"], ev["pubkeys"], ev["messages"]
         )
+        set_totals[ev["kind"]] = set_totals.get(ev["kind"], 0) + ev["n_sets"]
+        if ev.get("qos", "deadline") == "bulk":
+            bulk_pending.append((ReplaySubmission(ev["kind"], sets), ev["t"]))
+            bulk_pending_sets += ev["n_sets"]
+            bulk_set_total += ev["n_sets"]
+            submissions.append([ev["kind"], ev["n_sets"], "bulk"])
+            if (
+                bulk_full_at is None
+                and bulk_pending_sets >= bulk_flush_sets
+            ):
+                bulk_full_at = ev["t"]
+            continue
         pending.append((ReplaySubmission(ev["kind"], sets), ev["t"]))
         pending_sets += ev["n_sets"]
         submissions.append([ev["kind"], ev["n_sets"]])
-        set_totals[ev["kind"]] = set_totals.get(ev["kind"], 0) + ev["n_sets"]
         while pending_sets >= max_batch_sets:
             drain_one("full")
     while pending:
         drain_one("shutdown")
+    while bulk_pending:
+        drain_one("shutdown", qos="bulk")
 
     body = {
         "n_events": len(events),
         "deadline_ms": round(deadline_ms, 3),
         "max_batch_sets": max_batch_sets,
+        "bulk_flush_sets": bulk_flush_sets,
+        "bulk_linger_ms": round(bulk_linger_ms, 3),
         "submissions": submissions,
         "bypasses": bypasses,
         "flushes": flushes,
         "set_totals": dict(sorted(set_totals.items())),
+        "bulk": {
+            "sets_offered": bulk_set_total,
+            "flushes": sum(1 for f in flushes if f["qos"] == "bulk"),
+        },
     }
     digest = hashlib.sha256(
         json.dumps(body, sort_keys=True).encode()

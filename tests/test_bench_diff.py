@@ -18,7 +18,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _bench_doc(sets_per_sec, waste, wrapped=False, kt_bytes=45.0,
-               bubble=0.2, recover_s=0.5):
+               bubble=0.2, recover_s=0.5, bulk_p99=80.0):
     doc = {
         "metric": "bls_sigset_verifications_per_sec_per_chip",
         "value": sets_per_sec,
@@ -59,6 +59,16 @@ def _bench_doc(sets_per_sec, waste, wrapped=False, kt_bytes=45.0,
             "time_to_recover_s": recover_s,
             "slo_miss_ratio_degraded": 0.0,
             "post_recovery_sets_per_sec": 100.0,
+        },
+        # ISSUE 15: the bulk-QoS leg's gossip p99 UNDER bulk is gated
+        # (a growing number = the bulk class started moving the tail)
+        "bulk_leg": {
+            "gossip_p99_baseline_ms": 75.0,
+            "gossip_p99_under_bulk_ms": bulk_p99,
+            "gossip_p99_ratio": bulk_p99 / 75.0,
+            "gossip_miss_ratio_under_bulk": 0.0,
+            "bulk_sets_per_sec": 400.0,
+            "throttle_excursions": 1,
         },
     }
     return {"n": 1, "rc": 0, "parsed": doc} if wrapped else doc
@@ -135,6 +145,16 @@ def test_diff_exits_nonzero_on_regression(tmp_path):
         bench_diff.load_bench(old), bench_diff.load_bench(rc_bad)
     )
     assert rep_rc["regressions"] == ["chaos_time_to_recover_s"]
+    # ISSUE 15 gate: gossip's p99 under a saturating bulk load growing
+    # >20% (the bulk class moving gossip's tail) exits nonzero too
+    bq_bad = _write(
+        tmp_path, "h_bq.json", _bench_doc(10.0, 0.5, bulk_p99=140.0)
+    )
+    assert bench_diff.main([old, bq_bad]) == 1
+    rep_bq = bench_diff.diff(
+        bench_diff.load_bench(old), bench_diff.load_bench(bq_bad)
+    )
+    assert rep_bq["regressions"] == ["bulk_gossip_p99_under_bulk_ms"]
     # a gate that cannot be evaluated is reported LOUDLY, not silently
     # dropped (exit stays 0 — absence of data is not a regression)
     legacy = dict(_bench_doc(10.0, 0.5))

@@ -510,6 +510,56 @@ def test_capacity_timeseries_and_burn_families_registered():
     import tools.capacity_report  # noqa: F401
 
 
+def test_bulk_qos_families_registered():
+    """ISSUE 15 families (the bulk QoS class: verification_service/
+    batcher.py queues + admission.py throttle) exist under their
+    declared types + labels, the journal kinds are in the sorted
+    catalogue, the sampler allowlist carries the bulk series, and the
+    trace schema's qos axis is the declared pair."""
+    _import_instrumented_modules()
+    reg = metrics.registry_snapshot()
+    want = {
+        "verification_scheduler_bulk_queue_depth": ("gauge", None),
+        "verification_scheduler_bulk_sets_total": ("counter", ("kind",)),
+        "verification_scheduler_bulk_shed_total": ("counter", ("kind",)),
+        "verification_scheduler_bulk_throttled": ("gauge", None),
+        "verification_scheduler_bulk_throttle_events_total": (
+            "counter", ("reason",),
+        ),
+    }
+    for name, (kind, labels) in want.items():
+        m = reg.get(name)
+        assert m is not None, f"family {name} not registered"
+        assert m.kind == kind, (name, m.kind)
+        if labels is not None:
+            assert m.labelnames == labels, (name, m.labelnames)
+        else:
+            assert not hasattr(m, "labelnames"), name  # unlabeled family
+    from lighthouse_tpu.utils import flight_recorder, timeseries
+    from lighthouse_tpu.verification_service import traffic
+
+    assert "bulk_throttle" in flight_recorder.EVENT_KINDS
+    assert "bulk_resume" in flight_recorder.EVENT_KINDS
+    fams = {s.family for s in timeseries.SAMPLE_FAMILIES}
+    assert {
+        "capacity_bulk_queue_depth",
+        "capacity_bulk_sets_per_sec",
+        "capacity_bulk_throttled",
+    } <= fams
+    assert traffic._QOS == ("deadline", "bulk")
+    # the bulk AOT rungs close the compile ladder at LOWEST priority:
+    # gossip's headline rungs must all warm before backfill's. Their
+    # geometry is the real wired bulk callers' (proposal signatures:
+    # K=1, one distinct message per set => M pads to B — an M=8 rung
+    # could never cover a bulk drain)
+    from lighthouse_tpu.compile_service import DEFAULT_RUNGS
+
+    assert DEFAULT_RUNGS[0] == (64, 16, 8)
+    assert DEFAULT_RUNGS[-2:] == ((512, 1, 512), (256, 1, 256))
+    for b, k, m in DEFAULT_RUNGS[-2:]:
+        assert m >= b, "a bulk rung must cover per-set-distinct messages"
+
+
 def test_warmup_tool_imports_and_dry_run_lists_ladder(capsys, monkeypatch):
     """ISSUE 5 CI satellite: ``tools/warmup.py`` must import cleanly and
     ``--dry-run`` must list the ladder walk WITHOUT compiling anything

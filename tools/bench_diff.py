@@ -97,11 +97,29 @@ METRICS = (
      ("chaos_leg", "slo_miss_ratio_degraded"), False),
     ("chaos_post_recovery_sets_per_sec",
      ("chaos_leg", "post_recovery_sets_per_sec"), True),
+    # ISSUE 15: the bulk-QoS leg — gossip's worst-kind p99 WITH a
+    # saturating bulk backfill running is gated (a growing number means
+    # the bulk class started moving gossip's tail, the exact failure
+    # mode the class exists to prevent); the baseline p99, the
+    # under-bulk/baseline ratio, the bulk side's served throughput and
+    # the throttle excursion count ride along ungated (stub-backend
+    # wall-clock numbers, tracked not SLO'd)
+    ("bulk_gossip_p99_under_bulk_ms",
+     ("bulk_leg", "gossip_p99_under_bulk_ms"), False),
+    ("bulk_gossip_p99_baseline_ms",
+     ("bulk_leg", "gossip_p99_baseline_ms"), None),
+    ("bulk_gossip_p99_ratio", ("bulk_leg", "gossip_p99_ratio"), False),
+    ("bulk_gossip_miss_ratio_under_bulk",
+     ("bulk_leg", "gossip_miss_ratio_under_bulk"), False),
+    ("bulk_sets_per_sec", ("bulk_leg", "bulk_sets_per_sec"), True),
+    ("bulk_throttle_excursions",
+     ("bulk_leg", "throttle_excursions"), None),
 )
 
 # the metrics whose regression exits nonzero (ISSUE 8 throughput/waste
 # gates + the ISSUE 10 key-table bytes gate + the ISSUE 11 dp gate +
-# the ISSUE 12 pipeline-bubble gate + the ISSUE 13 recovery gate)
+# the ISSUE 12 pipeline-bubble gate + the ISSUE 13 recovery gate + the
+# ISSUE 15 gossip-p99-under-bulk gate)
 GATED = (
     "headline_sets_per_sec",
     "headline_padding_waste",
@@ -109,6 +127,7 @@ GATED = (
     "dp2_sets_per_sec",
     "pipeline_bubble_ratio",
     "chaos_time_to_recover_s",
+    "bulk_gossip_p99_under_bulk_ms",
 )
 
 

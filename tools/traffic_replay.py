@@ -389,9 +389,12 @@ def run_timed_replay(
             if ev["path"] == "verify_now":
                 ok = sched.verify_now(sets, ev["kind"])
             else:
-                ok = sched.submit(sets, ev["kind"]).result(
-                    timeout=result_timeout_s
-                )
+                # bulk-class events (ISSUE 15) ride the bulk queue —
+                # idle-time big-rung flushes under admission control —
+                # and their callers block self-paced, like real backfill
+                ok = sched.submit(
+                    sets, ev["kind"], qos=ev.get("qos", "deadline")
+                ).result(timeout=result_timeout_s)
         except Exception:
             with olock:
                 outcomes["error"] += 1
