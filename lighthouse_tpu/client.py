@@ -94,6 +94,13 @@ class ClientConfig:
     # Only effective with bls_backend="tpu"; LIGHTHOUSE_TPU_DP_MESH=0
     # disables the mesh entirely.
     dp_devices: Optional[int] = None
+    # device-side operation_pool aggregation (ISSUE 16): route the
+    # pool's G2 signature point-sums through the windowed-MSM surface
+    # (operation_pool/device_agg.py; programs warmed on the compile
+    # service's MSM ladder). OFF by default: the host fold is correct
+    # and byte-identical — this only buys the batched-sum speedup. Only
+    # effective with bls_backend="tpu".
+    device_msm: bool = False
 
 
 class Client:
@@ -382,6 +389,17 @@ class ClientBuilder:
                 chain.op_pool = OperationPool(self.preset, self.spec, self.types)
         else:
             chain.op_pool = OperationPool(self.preset, self.spec, self.types)
+
+        if cfg.bls_backend == "tpu" and cfg.device_msm:
+            # device-side pool aggregation (ISSUE 16): attach AFTER
+            # construction so the persistence-restored pool gets it too;
+            # also opt the compile service's AOT walk into warming the
+            # MSM ladder so the first real aggregate pays no compile
+            from .compile_service.service import set_msm_warm_enabled
+            from .operation_pool import DeviceAggregator
+
+            chain.op_pool.set_device_aggregator(DeviceAggregator())
+            set_msm_warm_enabled(True)
 
         if cfg.slasher:
             from .slasher import Slasher

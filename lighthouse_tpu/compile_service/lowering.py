@@ -208,6 +208,42 @@ def warm_gather(B: int, K: int, table, shard=None) -> dict:
     return {"seconds": elapsed, "fresh": fresh}
 
 
+def warm_msm(n: int, shard=None) -> dict:
+    """Warm the device MSM pair (ISSUE 16) at point-count rung ``n``:
+    the G1 windowed-MSM program AND the G2 masked point-sum program the
+    operation_pool's device aggregation dispatches. Both go through
+    ``bls._run_stage`` under the shared stage label "msm" (their arg
+    shapes differ, so they key distinct recompile entries), exactly like
+    gathered traffic — the recompile counter and stage histogram see
+    what real aggregation sees. Keyed on the point axis only: warming
+    the MSM ladder can never perturb the staged (B, K, M) shapes."""
+    import jax.numpy as jnp
+
+    from ..crypto.device import bls as dbls
+    from ..crypto.device import fp
+
+    seconds = 0.0
+    fresh = False
+    with _shard_scope(shard):
+        g1_args = (
+            jnp.zeros((n, 2, fp.NL), jnp.int32),       # pt_xy
+            jnp.ones((n,), bool),                      # pt_inf
+            jnp.zeros((n, 2), jnp.int32),              # scalars (u64 words)
+        )
+        g2_args = (
+            jnp.zeros((n, 2, 2, fp.NL), jnp.int32),    # pt_xy
+            jnp.ones((n,), bool),                      # pt_inf
+        )
+        for prog, args in ((dbls._msm, g1_args), (dbls._g2sum, g2_args)):
+            try:
+                _, elapsed, was_fresh = dbls._run_stage("msm", prog, *args)
+            except Exception as e:
+                raise StageWarmupError("msm", {}, e)
+            seconds += elapsed
+            fresh = fresh or was_fresh
+    return {"seconds": seconds, "fresh": fresh}
+
+
 def warm_staged(B: int, K: int, M: int, shard=None) -> dict:
     """Warm the staged pipeline at rung (B, K, M) under the ACTIVE fp
     impl: dispatch each module-level jitted stage on zero-filled dummy

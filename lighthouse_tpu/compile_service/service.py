@@ -98,6 +98,32 @@ DEFAULT_RUNGS: Tuple[Rung, ...] = (
     (256, 1, 256),
 )
 
+# device MSM ladder (ISSUE 16): padded point counts N the G1 windowed
+# MSM / G2 point-sum staged programs are warmed at. These programs are
+# keyed on their OWN rung (the point axis), NOT on (B, K, M) — an MSM
+# dispatch can never perturb the staged-verify ladder's warm shapes.
+# 512 covers a full mainnet committee; the smaller rungs are the
+# operation_pool's greedy-merge and sync-contribution batch sizes.
+# Warming is OFF unless a caller opts in (ClientConfig.device_msm ->
+# set_msm_warm_enabled): nodes not running the device aggregation path
+# must not spend AOT minutes on programs they never dispatch.
+MSM_RUNGS: Tuple[int, ...] = (64, 128, 256, 512)
+
+_msm_warm_enabled = False
+
+
+def set_msm_warm_enabled(on: bool) -> None:
+    """Opt the AOT walk into warming the MSM ladder alongside the first
+    staged rung (per fp-impl x device). Process-global because the
+    service is constructed before the client config is applied."""
+    global _msm_warm_enabled
+    _msm_warm_enabled = bool(on)
+
+
+def msm_warm_enabled() -> bool:
+    return _msm_warm_enabled
+
+
 _ENV_ENABLED = "LIGHTHOUSE_TPU_COMPILE_SERVICE"
 _ENV_RUNGS = "LIGHTHOUSE_TPU_COMPILE_RUNGS"
 # compile retry (ISSUE 13): a compile_failed rung re-queues with
@@ -349,6 +375,10 @@ class CompileService:
         self.retry_max_s = _env_float(_ENV_RETRY_CAP, DEFAULT_RETRY_MAX_S)
         self._attempts: dict = {}   # (rung, device) -> failures so far
         self._retry_at: dict = {}   # (rung, device) -> due monotonic time
+        # MSM ladder (ISSUE 16): (fp_impl, device) pairs whose MSM rungs
+        # are already warm — the ladder rides the FIRST staged rung
+        # compile per pair, not every rung
+        self._msm_warmed: set = set()
         self._retries_total = 0
         # rung-cost feed (ISSUE 14): measured verify cost from
         # note_rung_verified — bounded by ladder size x mesh width (the
@@ -422,6 +452,9 @@ class CompileService:
             # every rung with a fresh failure budget
             self._retry_at.clear()
             self._attempts.clear()
+            # the new epoch's jit caches are empty: the MSM ladder must
+            # re-warm alongside the re-queued plan
+            self._msm_warmed.clear()
             for rung in self.plan:
                 for dev in self._devices:
                     # even_in_flight: a rung compiling RIGHT NOW finishes
@@ -1024,6 +1057,33 @@ class CompileService:
                     )
             except Exception:
                 _COMPILES.with_labels("gather", "error").inc()
+        # MSM ladder (ISSUE 16): when the node opted into device
+        # aggregation, warm the windowed-MSM / G2-sum programs alongside
+        # staged rung compiles — ONE cold MSM rung per staged compile,
+        # smallest first, so the background chunk stays bounded (a full
+        # 4-rung interpret-mode warm monopolizes the worker — and the
+        # GIL — for minutes, starving health serving and shutdown). They
+        # are keyed on their own point-count rung, so this never disturbs
+        # the staged shapes above; a failure degrades the device-MSM path
+        # only (the operation_pool falls back to host sums, and a cold
+        # MSM rung compiles on first use) and must not fail the rung.
+        if self._compile_rung_fn is None and msm_warm_enabled():
+            for n in MSM_RUNGS:
+                mkey = (impl, dev, n)
+                if mkey in self._msm_warmed or self._stopped:
+                    continue
+                from . import lowering
+
+                try:
+                    mrec = lowering.warm_msm(n, shard=dev)
+                    _COMPILES.with_labels("msm", "ok").inc()
+                    _COMPILE_SECONDS.with_labels("msm").observe(
+                        float(mrec.get("seconds", 0.0))
+                    )
+                    self._msm_warmed.add(mkey)
+                except Exception:
+                    _COMPILES.with_labels("msm", "error").inc()
+                break
         # manifest honesty: a FRESH compile that left no new executable
         # in the cache dir must not add manifest entries — the manifest
         # stays at least as conservative as the cache

@@ -51,7 +51,7 @@ from ...utils import (
 from ..params import DST, G1_X, G1_Y, P, R, X
 from ..cpu.pairing import PSI_CX, PSI_CY
 from ..cpu.hash_to_curve import hash_to_g2
-from . import curve, fp, fp2, pairing, tower
+from . import curve, fp, fp2, msm as msm_mod, pairing, tower
 from .pairing import X_ABS
 
 # psi constants (public, derived from xi; see cpu/pairing.py:22-27).
@@ -413,6 +413,10 @@ _stage1 = jax.jit(_stage1_fn)
 _stage2 = jax.jit(_stage2_fn)
 _stage3 = jax.jit(_stage3_fn)
 _gather = jax.jit(_gather_fn)
+# MSM family (ISSUE 16): small independent programs keyed on their own
+# N rung — they never disturb the warm stage-1/2/3 shapes.
+_msm = jax.jit(msm_mod.msm_g1_fn)
+_g2sum = jax.jit(msm_mod.sum_g2_fn)
 
 
 # ---------------------------------------------------------------------------
@@ -519,6 +523,11 @@ def _run_stage(stage: str, fn, *args):
     key = (
         stage,
         impl,
+        # upper-layer engine seams (ISSUE 16): a fused fp2 kernel or a
+        # restructured line-eval step is a different traced program, so
+        # switching either makes the next dispatch a fresh compile
+        fp2.get_impl(),
+        pairing.get_line_impl(),
         shard,
         tuple((tuple(a.shape), str(a.dtype)) for a in args),
     )
@@ -710,6 +719,69 @@ def _staged_verify(
     if not verdict:
         flight_recorder.dump_on_failure("stage_verify_failure", **geometry)
     return out
+
+
+# ---------------------------------------------------------------------------
+# MSM-family staged programs (ISSUE 16)
+# ---------------------------------------------------------------------------
+
+def run_msm_g1(pt_xy, pt_inf, scalars):
+    """Dispatch the windowed G1 MSM staged program (device arrays in,
+    device arrays out). Keyed like every staged program — recompile
+    accounting, stage histogram (stage label "msm"), profiler span."""
+    out, _s, _f = _run_stage("msm", _msm, pt_xy, pt_inf, scalars)
+    return out
+
+
+def run_g2_sum(pt_xy, pt_inf):
+    """Dispatch the masked G2 point-sum staged program (the aggregate
+    half of the MSM family; same "msm" stage label)."""
+    out, _s, _f = _run_stage("msm", _g2sum, pt_xy, pt_inf)
+    return out
+
+
+def device_msm_g1(points, scalars, pad_n: int | None = None):
+    """Host helper: cpu G1Point list + u64 scalars -> their device MSM
+    as a cpu G1Point. N pads to the bucket ladder so repeated calls
+    reuse warm MSM-rung programs; padding lanes are infinity with zero
+    scalars (no contribution, complete group law)."""
+    pts = list(points)
+    sc = list(scalars)
+    assert len(pts) == len(sc)
+    N = pad_n or _round_up(max(len(pts), 1))
+    xy = np.zeros((N, 2, fp.NL), np.int32)
+    inf = np.ones((N,), bool)
+    sw = np.zeros((N, 2), np.int32)
+    if pts:
+        pxy, pinf = curve.pack_g1(pts)
+        xy[: len(pts)] = pxy
+        inf[: len(pts)] = pinf
+    for i, s in enumerate(sc):
+        # u64 -> two's-complement int32 words (numpy rejects narrowing
+        # casts of out-of-range Python ints; a view reinterprets safely)
+        sw[i] = np.array(
+            [(s >> 32) & 0xFFFFFFFF, s & 0xFFFFFFFF], np.uint32
+        ).view(np.int32)
+    oxy, oinf = run_msm_g1(
+        jnp.asarray(xy), jnp.asarray(inf), jnp.asarray(sw)
+    )
+    return curve.unpack_g1(np.asarray(oxy)[None], np.asarray(oinf)[None])[0]
+
+
+def device_sum_g2(points, pad_n: int | None = None):
+    """Host helper: cpu G2Point list -> their device point sum as a cpu
+    G2Point (operation_pool's aggregation path). Padding lanes are
+    infinity; an empty list returns infinity."""
+    pts = list(points)
+    N = pad_n or _round_up(max(len(pts), 1))
+    xy = np.zeros((N, 2, 2, fp.NL), np.int32)
+    inf = np.ones((N,), bool)
+    if pts:
+        pxy, pinf = curve.pack_g2(pts)
+        xy[: len(pts)] = pxy
+        inf[: len(pts)] = pinf
+    oxy, oinf = run_g2_sum(jnp.asarray(xy), jnp.asarray(inf))
+    return curve.unpack_g2(np.asarray(oxy)[None], np.asarray(oinf)[None])[0]
 
 
 # ---------------------------------------------------------------------------

@@ -181,13 +181,35 @@ def _carry_round(cols, bounds):
     return r + c, [a + b for a, b in zip(rb, cb)]
 
 
+# Pallas kernels may not capture traced constants: a kernel that calls
+# reduce_cols passes the FOLD table in through a ref and installs it here
+# for the duration of its trace (see pallas_fp2.py). None -> the module
+# table as usual.
+_FOLD_OVERRIDE = None
+
+
+@contextlib.contextmanager
+def fold_table(table):
+    """Scoped FOLD-table source override (trace-time, kernel-internal)."""
+    global _FOLD_OVERRIDE
+    prev = _FOLD_OVERRIDE
+    _FOLD_OVERRIDE = table
+    try:
+        yield
+    finally:
+        _FOLD_OVERRIDE = prev
+
+
 def _fold_round(cols, bounds):
     """Fold limbs >= NL through the 2**(12i) mod p table (exact mod p)."""
     n = len(bounds)
     k = n - NL
     assert k > 0
     lo, hi = cols[..., :NL], cols[..., NL:]
-    table = jnp.asarray(FOLD[:k])
+    table = (
+        jnp.asarray(FOLD[:k]) if _FOLD_OVERRIDE is None
+        else _FOLD_OVERRIDE[:k]
+    )
     out = lo + jnp.einsum("...h,hl->...l", hi, table,
                           preferred_element_type=jnp.int32)
     ob = [bounds[i] + sum(bounds[NL + h] * int(FOLD[h, i]) for h in range(k))

@@ -10,9 +10,22 @@ the asm backend).
 Every product here drains into :func:`fp.mul` and therefore inherits the
 active ``FP_IMPL`` engine (int32 Toeplitz dot / int8 MXU decomposition /
 Pallas kernel) without any change at this layer.
+
+This layer has its OWN engine seam on top (ISSUE 16): the default
+``composed`` implementation emits the Karatsuba recombination as separate
+XLA ops around one batched ``fp.mul``; ``fused_pallas`` hands the whole
+product — contraction, reduction and Karatsuba combine — to one Pallas
+tile (:mod:`.pallas_fp2`). Select with ``LIGHTHOUSE_TPU_FP2_IMPL`` (env)
+or :func:`set_impl` / the :func:`impl` context manager. Dispatch happens
+at TRACE time: callers holding jitted programs must call
+``device.reset_compiled_state()`` after switching, exactly like the fp
+seam.
 """
 
 from __future__ import annotations
+
+import contextlib
+import os
 
 import numpy as np
 
@@ -74,7 +87,7 @@ def _bstack(elems, axis):
     return jnp.stack([jnp.broadcast_to(e, target) for e in elems], axis=axis)
 
 
-def mul(x, y):
+def _mul_composed(x, y):
     """(a0 + a1 u)(b0 + b1 u) via Karatsuba, with the three Fp products
     stacked into ONE batched fp.mul — the whole tower funnels its
     component products into single big contractions this way (small HLO
@@ -89,6 +102,18 @@ def mul(x, y):
     return pack(fp.sub(t0, t1), fp.sub(m, fp.add(t0, t1)))
 
 
+def _mul_fused(x, y):
+    from . import pallas_fp2
+
+    x, y = jnp.broadcast_arrays(x, y)
+    return pallas_fp2.mul2(x, y)
+
+
+def mul(x, y):
+    """Fp2 product under the active implementation (see module docstring)."""
+    return _IMPLS[_active_impl][0](x, y)
+
+
 def mul_pairs(pairs):
     """[(x_i, y_i)] -> [x_i * y_i] with ALL products in one batched fp.mul.
 
@@ -101,7 +126,16 @@ def mul_pairs(pairs):
     return [out[..., i, :, :] for i in range(len(pairs))]
 
 
-def sq(x):
+def sq_batch(elems):
+    """[x_i] -> [x_i^2] with ALL squarings in one batched call (the
+    squaring sibling of :func:`mul_pairs`; used by the fused line-eval
+    steps in pairing.py)."""
+    xs = _bstack(elems, -3)
+    out = sq(xs)
+    return [out[..., i, :, :] for i in range(len(elems))]
+
+
+def _sq_composed(x):
     """(a0 + a1 u)^2 = (a0+a1)(a0-a1) + 2 a0 a1 u (one batched fp.mul)."""
     a0, a1 = c0(x), c1(x)
     xs = _bstack([fp.add(a0, a1), a0], -2)
@@ -109,6 +143,63 @@ def sq(x):
     t = fp.mul(xs, ys)
     t2 = t[..., 1, :]
     return pack(t[..., 0, :], fp.add(t2, t2))
+
+
+def _sq_fused(x):
+    from . import pallas_fp2
+
+    return pallas_fp2.sq2(x)
+
+
+def sq(x):
+    """Fp2 squaring under the active implementation."""
+    return _IMPLS[_active_impl][1](x)
+
+
+# ---------------------------------------------------------------------------
+# Implementation selection (mirrors the fp.mul engine seam)
+# ---------------------------------------------------------------------------
+
+IMPL_COMPOSED = "composed"
+IMPL_FUSED_PALLAS = "fused_pallas"
+
+_IMPLS = {
+    IMPL_COMPOSED: (_mul_composed, _sq_composed),
+    IMPL_FUSED_PALLAS: (_mul_fused, _sq_fused),
+}
+
+_active_impl = os.environ.get("LIGHTHOUSE_TPU_FP2_IMPL", IMPL_COMPOSED)
+if _active_impl not in _IMPLS:
+    raise KeyError(
+        f"LIGHTHOUSE_TPU_FP2_IMPL={_active_impl!r} unknown; "
+        f"have {sorted(_IMPLS)}"
+    )
+
+
+def get_impl() -> str:
+    return _active_impl
+
+
+def set_impl(name: str) -> None:
+    """Select the Fp2 implementation. Dispatch happens at TRACE time:
+    callers holding jitted programs must call
+    ``device.reset_compiled_state()`` afterwards (same contract as
+    ``fp.set_impl``)."""
+    global _active_impl
+    if name not in _IMPLS:
+        raise KeyError(f"unknown fp2 impl {name!r}; have {sorted(_IMPLS)}")
+    _active_impl = name
+
+
+@contextlib.contextmanager
+def impl(name: str):
+    """Scoped implementation switch (restores the previous choice)."""
+    prev = _active_impl
+    set_impl(name)
+    try:
+        yield
+    finally:
+        set_impl(prev)
 
 
 def conjugate(x):

@@ -1,0 +1,126 @@
+"""Windowed multi-scalar multiplication (Pippenger) on device.
+
+``sum_i s_i * P_i`` over G1 with 64-bit scalars — the device half of
+batch-verification randomizer sums and of ``operation_pool`` aggregate
+precomputation (ISSUE 16; ROADMAP item 3's duty-lookahead caller). The
+classic bucket method, restated branch-free for a batch machine:
+
+* scalars split into ``N_WINDOWS`` windows of ``WINDOW_BITS`` bits
+  (MSW first);
+* bucket sums ``B[w, j] = sum of P_i where digit_w(s_i) == j`` computed
+  as ONE masked tree-reduction over the point axis, batched over all
+  ``N_WINDOWS x N_BUCKETS`` buckets at once — no scatter, no sort, and
+  the reduction scan emits a single group-law body (compile-size first,
+  like every reduction in this stack);
+* per-window weighted sums ``W_w = sum_j j * B[w, j]`` by the running-sum
+  trick (one scan over the bucket axis, highest bucket first);
+* the final Horner fold ``acc = 2^WINDOW_BITS * acc + W_w`` over windows.
+
+The complete RCB group law makes every masked/duplicate/infinity lane
+safe without branches; infinity inputs simply occupy no bucket. A plain
+masked point-sum (``point_sum``) rides along for aggregate callers whose
+scalars are all one (operation_pool signature aggregation over G2).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from . import curve, fp, fp2
+
+WINDOW_BITS = 4
+N_WINDOWS = 64 // WINDOW_BITS        # 16, MSW first
+N_BUCKETS = (1 << WINDOW_BITS) - 1   # 15; digit 0 occupies no bucket
+
+
+def window_digits(scalars):
+    """int32[..., 2] (hi, lo) words of a u64 -> int32[..., N_WINDOWS]
+    window digits, most-significant window first."""
+    hi = scalars[..., 0].astype(jnp.uint32)
+    lo = scalars[..., 1].astype(jnp.uint32)
+    mask = (1 << WINDOW_BITS) - 1
+    digs = []
+    for w in range(N_WINDOWS):
+        bit = 64 - (w + 1) * WINDOW_BITS
+        word = hi if bit >= 32 else lo
+        digs.append(((word >> (bit % 32)) & mask).astype(jnp.int32))
+    return jnp.stack(digs, axis=-1)
+
+
+def _bucket_points(F, proj, digits, n):
+    """Masked bucket occupancy: broadcast the projective batch to
+    ``[N_WINDOWS, N_BUCKETS, n]`` and select infinity everywhere the
+    point's window digit is not the bucket's index."""
+    j = jnp.arange(1, N_BUCKETS + 1, dtype=jnp.int32)
+    sel = digits.T[:, None, :] == j[None, :, None]   # [W, B, n]
+    shape = (N_WINDOWS, N_BUCKETS, n)
+    broad = tuple(
+        jnp.broadcast_to(c, shape + c.shape[1:]) for c in proj
+    )
+    inf = curve.infinity(F, shape)
+    return curve.select(F, sel, broad, inf)
+
+
+def msm(F, pt_aff, scalars):
+    """Generic windowed MSM over field module ``F``:
+    ``pt_aff = (x, y, inf)`` affine batch [n, ...], ``scalars`` int32
+    [n, 2] u64 words -> projective result point (batch dims reduced)."""
+    x, y, inf = pt_aff
+    n = x.shape[0]
+    proj = curve.from_affine(F, x, y, inf)
+    digits = window_digits(scalars)                  # [n, W]
+    masked = _bucket_points(F, proj, digits, n)      # [W, B, n] points
+    buckets = curve.sum_points(F, masked, axis=2)    # [W, B] points
+
+    # W_w = sum_j j * B[w, j] via running sums, highest bucket first:
+    # run_k = sum_{j >= k} B_j, acc = sum_k run_k.
+    rev = tuple(c[:, ::-1] for c in buckets)
+    seq = tuple(jnp.moveaxis(c, 1, 0) for c in rev)  # [B, W] scan axis first
+    zero = curve.infinity(F, (N_WINDOWS,))
+
+    def bucket_step(carry, bj):
+        run, acc = carry
+        run = curve.add(F, run, bj)
+        acc = curve.add(F, acc, run)
+        return (run, acc), None
+
+    (_, windows), _ = lax.scan(bucket_step, (zero, zero), seq)
+
+    # Horner across windows (MSW first): acc = 2^w * acc + W_w.
+    def window_step(acc, wp):
+        for _ in range(WINDOW_BITS):
+            acc = curve.dbl(F, acc)
+        return curve.add(F, acc, wp), None
+
+    acc, _ = lax.scan(window_step, curve.infinity(F), windows)
+    return acc
+
+
+def point_sum(F, pt_aff):
+    """Masked affine point sum (all-ones scalars): the aggregate-only
+    fast path operation_pool's device aggregation uses."""
+    x, y, inf = pt_aff
+    proj = curve.from_affine(F, x, y, inf)
+    return curve.sum_points(F, proj, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# Staged-program bodies (jitted by device/bls.py, warmed via lowering.py)
+# ---------------------------------------------------------------------------
+
+def msm_g1_fn(pt_xy, pt_inf, scalars):
+    """G1 windowed MSM staged program: pt_xy int32[N, 2, NL] affine,
+    pt_inf bool[N], scalars int32[N, 2] -> (xy int32[2, NL] canonical
+    affine, inf bool[])."""
+    acc = msm(fp, (pt_xy[:, 0], pt_xy[:, 1], pt_inf), scalars)
+    ax, ay, ainf = curve.to_affine(fp, acc)
+    return jnp.stack([ax, ay], axis=0), ainf
+
+
+def sum_g2_fn(pt_xy, pt_inf):
+    """G2 masked point-sum staged program: pt_xy int32[N, 2, 2, NL]
+    affine, pt_inf bool[N] -> (xy int32[2, 2, NL], inf bool[])."""
+    acc = point_sum(fp2, (pt_xy[:, 0], pt_xy[:, 1], pt_inf))
+    ax, ay, ainf = curve.to_affine(fp2, acc)
+    return jnp.stack([ax, ay], axis=0), ainf

@@ -36,6 +36,9 @@ affine over Fq12 with per-step inversions:
 
 from __future__ import annotations
 
+import contextlib
+import os
+
 import numpy as np
 
 import jax.numpy as jnp
@@ -45,6 +48,59 @@ from ..params import P, R, X
 from . import curve, fp, fp2, tower
 
 X_ABS = -X  # 0xd201000000010000, the positive BLS parameter
+
+
+# ---------------------------------------------------------------------------
+# Line-evaluation engine seam (ISSUE 16)
+#
+# ``composed`` emits each Miller-loop step as ~13-15 individual fp2
+# dispatches; ``fused`` restructures the same formulas into
+# dependency-leveled ``fp2.mul_pairs``/``fp2.sq_batch`` batches (5-6
+# dispatches per step), which both shrinks the staged HLO bodies and
+# hands whole batches to the fused Fp2 Pallas kernel when that engine is
+# active. Identical canonical values either way (differentially pinned).
+# ---------------------------------------------------------------------------
+
+IMPL_LINE_COMPOSED = "composed"
+IMPL_LINE_FUSED = "fused"
+
+_LINE_IMPLS = (IMPL_LINE_COMPOSED, IMPL_LINE_FUSED)
+
+_active_line_impl = os.environ.get(
+    "LIGHTHOUSE_TPU_LINE_IMPL", IMPL_LINE_COMPOSED
+)
+if _active_line_impl not in _LINE_IMPLS:
+    raise KeyError(
+        f"LIGHTHOUSE_TPU_LINE_IMPL={_active_line_impl!r} unknown; "
+        f"have {sorted(_LINE_IMPLS)}"
+    )
+
+
+def get_line_impl() -> str:
+    return _active_line_impl
+
+
+def set_line_impl(name: str) -> None:
+    """Select the line-eval step shape. Trace-time dispatch: callers
+    holding jitted programs must call ``device.reset_compiled_state()``
+    afterwards (same contract as ``fp.set_impl``)."""
+    global _active_line_impl
+    if name not in _LINE_IMPLS:
+        raise KeyError(
+            f"unknown line impl {name!r}; have {sorted(_LINE_IMPLS)}"
+        )
+    _active_line_impl = name
+
+
+@contextlib.contextmanager
+def line_impl(name: str):
+    """Scoped line-impl switch (restores the previous choice)."""
+    prev = _active_line_impl
+    set_line_impl(name)
+    try:
+        yield
+    finally:
+        set_line_impl(prev)
 
 
 # ---------------------------------------------------------------------------
@@ -81,9 +137,25 @@ def mul_by_line(f, s0, sv, sv2):
     )
 
 
+def _scale_batch(pairs):
+    """[(fp2 elem, fp scalar)] -> [elem * scalar] with every component
+    product in ONE fp.mul (the fused-step spelling of fp2.scale)."""
+    xs = fp2._bstack([x for x, _ in pairs], -3)
+    ks = fp2._bstack([k[..., None, :] for _, k in pairs], -3)
+    t = fp.mul(xs, ks)
+    return [t[..., i, :, :] for i in range(len(pairs))]
+
+
 def _dbl_step(T, xP, yP):
     """Jacobian doubling of T on E'(Fp2) + sparse line coefficients at
-    P = (xP, yP) in G1 affine. Returns (T2, s0, sv, sv2)."""
+    P = (xP, yP) in G1 affine, under the active line engine. Returns
+    (T2, s0, sv, sv2)."""
+    if _active_line_impl == IMPL_LINE_FUSED:
+        return _dbl_step_fused(T, xP, yP)
+    return _dbl_step_composed(T, xP, yP)
+
+
+def _dbl_step_composed(T, xP, yP):
     Xc, Yc, Zc = T
     A = fp2.sq(Xc)              # X^2
     B = fp2.sq(Yc)              # Y^2
@@ -104,6 +176,27 @@ def _dbl_step(T, xP, yP):
     sv = fp2.sub(fp2.add(B, B), fp2.mul(E, Xc))
     # sv2 = 3X^2 Z^2 * xP
     sv2 = fp2.scale(fp2.mul(E, Z2), xP)
+    return (X3, Y3, Z3), s0, sv, sv2
+
+
+def _dbl_step_fused(T, xP, yP):
+    """Same doubling + line formulas, restructured into dependency-leveled
+    batches: 3 squaring/mul batches + 1 product + 1 scale batch."""
+    Xc, Yc, Zc = T
+    A, B, Z2 = fp2.sq_batch([Xc, Yc, Zc])
+    E = fp2.add(fp2.add(A, A), A)  # 3X^2
+    C, XB2, F = fp2.sq_batch([B, fp2.add(Xc, B), E])
+    D = fp2.sub(XB2, fp2.add(A, C))
+    D = fp2.add(D, D)              # 4XY^2
+    X3 = fp2.sub(F, fp2.add(D, D))
+    EdX, Z3, EX, EZ2 = fp2.mul_pairs(
+        [(E, fp2.sub(D, X3)), (fp2.add(Yc, Yc), Zc), (E, Xc), (E, Z2)]
+    )
+    Y3 = fp2.sub(EdX, fp2.mul_small(C, 8))
+    sv = fp2.sub(fp2.add(B, B), EX)          # 2Y^2 - 3X^3
+    (z3z2,) = fp2.mul_pairs([(Z3, Z2)])      # 2YZ^3
+    s0c, sv2 = _scale_batch([(z3z2, yP), (EZ2, xP)])
+    s0 = fp2.mul_by_u_plus_1(fp2.neg(s0c))
     return (X3, Y3, Z3), s0, sv, sv2
 
 
@@ -150,7 +243,14 @@ def miller_loop(g1_aff, g2_aff):
 
 
 def _add_line(T, xQ, yQ, xP, yP):
-    """Mixed addition T + Q with sparse line coefficients at P."""
+    """Mixed addition T + Q with sparse line coefficients at P, under the
+    active line engine."""
+    if _active_line_impl == IMPL_LINE_FUSED:
+        return _add_line_fused(T, xQ, yQ, xP, yP)
+    return _add_line_composed(T, xQ, yQ, xP, yP)
+
+
+def _add_line_composed(T, xQ, yQ, xP, yP):
     Xc, Yc, Zc = T
     Z2 = fp2.sq(Zc)
     U2 = fp2.mul(xQ, Z2)
@@ -167,6 +267,29 @@ def _add_line(T, xQ, yQ, xP, yP):
     s0 = fp2.mul_by_u_plus_1(fp2.neg(fp2.scale(Z3, yP)))
     sv = fp2.sub(fp2.mul(Z3, yQ), fp2.mul(Rr, xQ))
     sv2 = fp2.scale(Rr, xP)
+    return (X3, Y3, Z3), s0, sv, sv2
+
+
+def _add_line_fused(T, xQ, yQ, xP, yP):
+    """Same mixed-addition + line formulas in dependency-leveled batches:
+    1 squaring + 4 product batches + 1 scale batch."""
+    Xc, Yc, Zc = T
+    Z2 = fp2.sq(Zc)
+    U2, ZZ2 = fp2.mul_pairs([(xQ, Z2), (Zc, Z2)])
+    H = fp2.sub(U2, Xc)
+    S2, HH = fp2.mul_pairs([(yQ, ZZ2), (H, H)])
+    Rr = fp2.sub(S2, Yc)
+    HHH, V, R2, Z3 = fp2.mul_pairs(
+        [(H, HH), (Xc, HH), (Rr, Rr), (Zc, H)]
+    )
+    X3 = fp2.sub(fp2.sub(R2, HHH), fp2.add(V, V))
+    t = fp2.mul_pairs(
+        [(Rr, fp2.sub(V, X3)), (Yc, HHH), (Z3, yQ), (Rr, xQ)]
+    )
+    Y3 = fp2.sub(t[0], t[1])
+    sv = fp2.sub(t[2], t[3])
+    s0c, sv2 = _scale_batch([(Z3, yP), (Rr, xP)])
+    s0 = fp2.mul_by_u_plus_1(fp2.neg(s0c))
     return (X3, Y3, Z3), s0, sv, sv2
 
 

@@ -1,0 +1,82 @@
+"""Device-side G2 signature aggregation for the operation pool (ISSUE 16).
+
+The pool's aggregation sites (greedy attestation merge on insert, sync
+contribution assembly, block sync-aggregate assembly) are host-side
+point-addition folds over ``AggregateSignature.add_assign``. With the
+device MSM surface open (``crypto/device/msm.py``) those folds can run
+as ONE masked point-sum on the accelerator: :class:`DeviceAggregator`
+batches the decoded G2 points, pads to the MSM ladder's warm rungs
+(``compile_service.service.MSM_RUNGS``), and dispatches
+``bls.device_sum_g2`` — the same staged program the compile service
+warms under the "msm" stage label.
+
+Strictly opt-in (``ClientConfig.device_msm``, default off) and strictly
+a fast path: the aggregate is the same group element either way, and
+serialization compresses the canonical affine point, so the flag-on
+output is BYTE-IDENTICAL to the host fold (pinned by
+``tests/test_device_msm.py``). Any device failure — and any batch below
+``min_batch`` — returns None and the caller's host fold serves, so a
+broken accelerator can only ever cost the speedup.
+"""
+
+from __future__ import annotations
+
+from ..utils import metrics
+
+_AGG = metrics.counter_vec(
+    "op_pool_device_agg_total",
+    "operation-pool aggregate computations by path: ok = one device G2 "
+    "point-sum served (dispatched under the bls stage label \"msm\"), "
+    "fallback = the device path failed and the host add_assign fold "
+    "served, small = batch below min_batch (host fold, device never "
+    "tried)",
+    ("outcome",),
+)
+
+
+class DeviceAggregator:
+    """Sums decoded G2 signature points on device (see module docstring).
+
+    ``min_batch`` keeps tiny folds (the 2-point greedy attestation
+    merge) on the host by default — a device round-trip per gossip
+    insert would be pure overhead; sync-committee assembly over dozens
+    to hundreds of messages is where the batched sum pays.
+    """
+
+    def __init__(self, min_batch: int = 2):
+        self.min_batch = max(1, int(min_batch))
+
+    @staticmethod
+    def _pad_n(n: int):
+        """Smallest warm MSM rung covering ``n``; None (= the generic
+        ``_round_up`` pad) when ``n`` exceeds the ladder."""
+        from ..compile_service.service import MSM_RUNGS
+
+        for r in sorted(MSM_RUNGS):
+            if r >= n:
+                return r
+        return None
+
+    def aggregate(self, sigs):
+        """Decoded ``bls.Signature`` list -> ``bls.AggregateSignature``
+        via one device point-sum, or None when the host fold should
+        serve (small batch, or any device failure)."""
+        from ..crypto import bls
+
+        if len(sigs) < self.min_batch:
+            _AGG.with_labels("small").inc()
+            return None
+        try:
+            pts = [s.point_or_infinity() for s in sigs]
+            from ..crypto.device import bls as dbls
+
+            out = dbls.device_sum_g2(pts, pad_n=self._pad_n(len(pts)))
+        except Exception:
+            _AGG.with_labels("fallback").inc()
+            return None
+        _AGG.with_labels("ok").inc()
+        if out.is_infinity():
+            # the canonical infinity encoding, exactly like the host
+            # fold's untouched AggregateSignature.infinity()
+            return bls.AggregateSignature.infinity()
+        return bls.AggregateSignature(out)

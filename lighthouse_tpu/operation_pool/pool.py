@@ -52,10 +52,15 @@ class _CompactAttestation:
 
 
 class OperationPool:
-    def __init__(self, preset, spec, types):
+    def __init__(self, preset, spec, types, device_agg=None):
         self.preset = preset
         self.spec = spec
         self.types = types
+        # opt-in device G2 aggregation (ISSUE 16): a DeviceAggregator
+        # routes the pool's signature point-sums through the device MSM
+        # surface; None (the default) keeps every fold on the host —
+        # byte-identical output either way (see device_agg.py)
+        self._device_agg = device_agg
         self._lock = threading.Lock()
         # (data_root) -> (data, [CompactAttestation])
         self._attestations: dict[bytes, tuple[object, list[_CompactAttestation]]] = {}
@@ -66,6 +71,26 @@ class OperationPool:
         self._sync_messages: dict[tuple[int, bytes], dict[int, bytes]] = {}
         # (slot, block_root, subcommittee) -> (bits, aggregated signature)
         self._sync_contributions: dict[tuple, tuple[list, bytes]] = {}
+
+    def set_device_aggregator(self, device_agg) -> None:
+        """Attach (or detach with None) the device aggregation path —
+        the client wires this after construction so the persistence
+        loader's pools pick it up too."""
+        self._device_agg = device_agg
+
+    def _aggregate(self, sigs):
+        """One AggregateSignature from decoded signatures: the device
+        point-sum path when attached and willing (ISSUE 16), else the
+        host ``add_assign`` fold. Both serialize the same group element,
+        so the choice is invisible in the pool's outputs."""
+        if self._device_agg is not None:
+            agg = self._device_agg.aggregate(sigs)
+            if agg is not None:
+                return agg
+        agg = bls.AggregateSignature.infinity()
+        for s in sigs:
+            agg.add_assign(s)
+        return agg
 
     # -- attestations ----------------------------------------------------
 
@@ -91,14 +116,18 @@ class OperationPool:
                 if bits == g.aggregation_bits:
                     return  # exact duplicate
                 if g.disjoint(bits):
-                    agg = bls.AggregateSignature.deserialize(bytes(g.signature))
-                    agg.add_assign(
-                        bls.Signature.deserialize(bytes(attestation.signature))
+                    merged = self._aggregate(
+                        [
+                            bls.Signature.deserialize(bytes(g.signature)),
+                            bls.Signature.deserialize(
+                                bytes(attestation.signature)
+                            ),
+                        ]
                     )
                     g.aggregation_bits = [
                         a or b for a, b in zip(g.aggregation_bits, bits)
                     ]
-                    g.signature = agg.serialize()
+                    g.signature = merged.serialize()
                     return
             groups.append(
                 _CompactAttestation(bits, bytes(attestation.signature))
@@ -245,17 +274,19 @@ class OperationPool:
             }
             stored = self._sync_contributions.get(key)
         bits = [False] * sub_size
-        agg = bls.AggregateSignature.infinity()
+        sigs = []
         for pos, raw in sorted(sub.items()):
             try:
-                agg.add_assign(bls.Signature.deserialize(raw))
+                s = bls.Signature.deserialize(raw)
+                s.point  # decompress NOW: a bad signature skips, like add_assign
             except bls.BlsError:
                 continue
+            sigs.append(s)
             bits[pos] = True
         if stored is not None and sum(stored[0]) > sum(bits):
             bits, sig_bytes = list(stored[0]), stored[1]
         elif any(bits):
-            sig_bytes = agg.serialize()
+            sig_bytes = self._aggregate(sigs).serialize()
         else:
             return None
         return self.types.SyncCommitteeContribution(
@@ -283,13 +314,15 @@ class OperationPool:
             return None
         size = self.preset.SYNC_COMMITTEE_SIZE
         sub_size = self.preset.sync_subcommittee_size
-        agg = bls.AggregateSignature.infinity()
+        sigs = []
         covered: set[int] = set()
         for subc, (bits, sig_raw) in contribs.items():
             try:
-                agg.add_assign(bls.Signature.deserialize(sig_raw))
+                s = bls.Signature.deserialize(sig_raw)
+                s.point
             except bls.BlsError:
                 continue
+            sigs.append(s)
             for pos, bit in enumerate(bits):
                 if bit:
                     covered.add(subc * sub_size + pos)
@@ -297,16 +330,18 @@ class OperationPool:
             if pos in covered:
                 continue  # already inside a contribution's aggregate
             try:
-                agg.add_assign(bls.Signature.deserialize(raw))
+                s = bls.Signature.deserialize(raw)
+                s.point
             except bls.BlsError:
                 continue  # undecodable signature: skip, never break production
+            sigs.append(s)
             covered.add(pos)
         if not covered:
             return None
         bits = [p in covered for p in range(size)]
         return self.types.SyncAggregate(
             sync_committee_bits=bits,
-            sync_committee_signature=agg.serialize(),
+            sync_committee_signature=self._aggregate(sigs).serialize(),
         )
 
     def packing_for_block(self, chain, state) -> dict:

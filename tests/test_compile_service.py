@@ -672,3 +672,71 @@ def test_geometry_extraction_signature_sets_and_triples():
     # opaque items (library users with custom verify fns) count
     # conservatively: one lane, one pubkey, one distinct message each
     assert _geometry([object(), object()]) == (2, 1, 2)
+
+
+# ---------------------------------------------------------------------------
+# MSM warm-alongside (ISSUE 16)
+# ---------------------------------------------------------------------------
+
+
+def test_msm_warm_incremental_one_rung_per_compile(monkeypatch):
+    """The opt-in MSM ladder warms ONE cold rung (smallest first) per
+    staged rung compile — never the whole ladder in one background
+    chunk — and a warm-call failure degrades quietly without blocking
+    later rungs or the staged compile itself."""
+    from lighthouse_tpu.compile_service import lowering
+    from lighthouse_tpu.compile_service import service as svc_mod
+
+    calls = []
+    monkeypatch.setattr(
+        lowering, "warm_staged",
+        lambda b, k, m, shard=None: {"stage1": {"seconds": 0.0}},
+    )
+    monkeypatch.setattr(
+        lowering, "warm_msm",
+        lambda n, shard=None: (calls.append(n), {"seconds": 0.0})[1],
+    )
+    plan = ((2, 1, 1), (4, 1, 1), (8, 1, 1), (16, 1, 1), (32, 1, 1))
+    svc = svc_mod.CompileService(rungs=plan)
+    # drive _compile_rung directly (no worker thread): un-set the
+    # constructed-stopped flag the hook honors for prompt shutdown
+    svc._stopped = False
+    svc_mod.set_msm_warm_enabled(True)
+    try:
+        for rung in plan:
+            svc._compile_rung(rung)
+        ladder = sorted(svc_mod.MSM_RUNGS)
+        # one rung per compile, smallest first; the 5th compile found
+        # the ladder fully warm and warmed nothing
+        assert calls == ladder
+        # flag off: no warm calls at all
+        svc2 = svc_mod.CompileService(rungs=plan)
+        svc2._stopped = False
+        svc_mod.set_msm_warm_enabled(False)
+        svc2._compile_rung(plan[0])
+        assert calls == ladder
+        # a stopped service warms nothing even with the flag on (a
+        # shutdown must never wait behind an MSM warm chunk)
+        svc_mod.set_msm_warm_enabled(True)
+        svc2._stopped = True
+        svc2._compile_rung(plan[1])
+        assert calls == ladder
+        # a failing warm call must not fail the rung and retries the
+        # SAME msm rung on the next staged compile
+        svc_mod.set_msm_warm_enabled(True)
+        svc3 = svc_mod.CompileService(rungs=plan)
+        svc3._stopped = False
+
+        def boom(n, shard=None):
+            raise RuntimeError("msm warm down")
+
+        monkeypatch.setattr(lowering, "warm_msm", boom)
+        svc3._compile_rung(plan[0])  # must not raise
+        monkeypatch.setattr(
+            lowering, "warm_msm",
+            lambda n, shard=None: (calls.append(n), {"seconds": 0.0})[1],
+        )
+        svc3._compile_rung(plan[1])
+        assert calls[-1] == ladder[0]
+    finally:
+        svc_mod.set_msm_warm_enabled(False)

@@ -1,0 +1,145 @@
+"""Device windowed-MSM family vs the host group law (ISSUE 16).
+
+Byte-identity is the acceptance bar for the whole family: the windowed
+G1 MSM and the masked G2 point-sum must agree with the pure-Python
+fold EXACTLY (same canonical compressed encoding), including infinity
+lanes, zero scalars, empty batches and ladder padding — the
+operation_pool's device aggregation path swaps in ONLY because the
+aggregate bytes cannot differ from the host fold's.
+
+Everything here runs at the smallest MSM rung (N=64) so the one-time
+compile stays inside the tier-1 wall-clock; the rung ladder itself is
+covered by the compile-service warmup tests.
+"""
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.crypto.cpu.curve import (
+    G1Point, G2Point, g1_generator, g2_generator,
+)
+from lighthouse_tpu.crypto.device import bls as dbls
+from lighthouse_tpu.operation_pool import DeviceAggregator
+from lighthouse_tpu.utils import metrics
+
+RUNG = 64
+
+
+def _g1_points(rng, n):
+    g = g1_generator()
+    return [g.mul(rng.randrange(1, 1 << 64)) for _ in range(n)]
+
+
+def _g2_points(rng, n):
+    g = g2_generator()
+    return [g.mul(rng.randrange(1, 1 << 64)) for _ in range(n)]
+
+
+def test_msm_g1_matches_host_fold(rng):
+    pts = _g1_points(rng, 4) + [G1Point.infinity()]
+    sc = [rng.randrange(1, 1 << 64) for _ in range(4)] + [rng.randrange(1, 1 << 64)]
+    # a zero scalar lane and an infinity-point lane must both vanish
+    pts.append(_g1_points(rng, 1)[0])
+    sc.append(0)
+    got = dbls.device_msm_g1(pts, sc, pad_n=RUNG)
+    want = G1Point.infinity()
+    for p, s in zip(pts, sc):
+        want = want + p.mul(s)
+    assert got == want
+    assert got.compress() == want.compress()
+
+
+def test_msm_g1_empty_and_all_infinity(rng):
+    assert dbls.device_msm_g1([], [], pad_n=RUNG).is_infinity()
+    out = dbls.device_msm_g1(
+        [G1Point.infinity()] * 3, [1, 2, 3], pad_n=RUNG
+    )
+    assert out.is_infinity()
+
+
+def test_g2_sum_matches_host_fold(rng):
+    pts = _g2_points(rng, 5) + [G2Point.infinity()]
+    got = dbls.device_sum_g2(pts, pad_n=RUNG)
+    want = G2Point.infinity()
+    for p in pts:
+        want = want + p
+    assert got == want
+    assert got.compress() == want.compress()
+    # empty batch is the canonical infinity
+    assert dbls.device_sum_g2([], pad_n=RUNG).is_infinity()
+
+
+def _host_fold(sigs):
+    agg = bls.AggregateSignature.infinity()
+    for s in sigs:
+        agg.add_assign(s)
+    return agg
+
+
+def test_device_aggregator_byte_identity(rng):
+    sigs = [bls.Signature(p) for p in _g2_points(rng, 7)]
+    sigs.append(bls.Signature.infinity())
+    got = DeviceAggregator().aggregate(sigs)
+    assert got is not None
+    assert got.serialize() == _host_fold(sigs).serialize()
+    # all-infinity batch folds to the canonical infinity encoding
+    inf = DeviceAggregator().aggregate([bls.Signature.infinity()] * 2)
+    assert inf is not None and inf.serialize() == bls.INFINITY_SIGNATURE
+
+
+def test_device_aggregator_small_batch_and_fallback(rng, monkeypatch):
+    agg = DeviceAggregator(min_batch=2)
+    c = metrics.counter_vec(
+        "op_pool_device_agg_total",
+        "operation_pool device aggregation outcomes",
+        ("outcome",),
+    )
+    small0 = c.with_labels("small").value
+    assert agg.aggregate([bls.Signature(p) for p in _g2_points(rng, 1)]) is None
+    assert agg.aggregate([]) is None
+    assert c.with_labels("small").value == small0 + 2
+
+    fb0 = c.with_labels("fallback").value
+
+    def boom(points, pad_n=None):
+        raise RuntimeError("device down")
+
+    monkeypatch.setattr(dbls, "device_sum_g2", boom)
+    assert agg.aggregate([bls.Signature(p) for p in _g2_points(rng, 3)]) is None
+    assert c.with_labels("fallback").value == fb0 + 1
+
+
+def test_pool_aggregate_seam_byte_identity(rng):
+    """The pool's ``_aggregate`` with a DeviceAggregator attached returns
+    byte-identical aggregates to the flag-off host fold, and a declining
+    aggregator (None) falls back to the host fold transparently."""
+    from lighthouse_tpu.operation_pool import OperationPool
+    from lighthouse_tpu.testing.harness import StateHarness
+    from lighthouse_tpu.types.chain_spec import minimal_spec
+    from lighthouse_tpu.types.preset import MINIMAL
+
+    h = StateHarness(MINIMAL, minimal_spec(), validator_count=8,
+                     fork_name="phase0", fake_sign=True)
+    host_pool = OperationPool(h.preset, h.spec, h.t)
+    dev_pool = OperationPool(h.preset, h.spec, h.t,
+                             device_agg=DeviceAggregator())
+    sigs = [bls.Signature(p) for p in _g2_points(rng, 4)]
+    want = host_pool._aggregate(sigs).serialize()
+    assert dev_pool._aggregate(sigs).serialize() == want
+
+    class _Declines:
+        def aggregate(self, sigs):
+            return None
+
+    dev_pool.set_device_aggregator(_Declines())
+    assert dev_pool._aggregate(sigs).serialize() == want
+    # below min_batch the device path declines too -> host fold
+    dev_pool.set_device_aggregator(DeviceAggregator(min_batch=99))
+    assert dev_pool._aggregate(sigs).serialize() == want
+
+
+def test_client_flag_default_off():
+    from lighthouse_tpu.client import ClientConfig
+
+    assert ClientConfig().device_msm is False

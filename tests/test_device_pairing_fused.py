@@ -1,0 +1,79 @@
+"""The tower/pairing differential suite, re-collected under the FUSED
+engines (``FP2_IMPL=fused_pallas`` + ``LINE_IMPL=fused``), plus the
+headline-rung staged verify under those engines (ISSUE 16).
+
+Every test function of ``test_device_pairing.py`` re-runs here with the
+autouse fixture switching both seams — the fused kernels' acceptance
+bar at this layer is "verdict-identical to the composed spelling across
+the whole tower/pairing differential surface", kept true BY
+CONSTRUCTION as the base suite grows. The base module parametrizes over
+both fp.mul engines; this re-collection pins the DEFAULT fp engine and
+varies the fp2/line seams instead (the fp × fp2 product space is
+covered at the cheap fp2 layer by ``test_zgate1_fp2_fused_matrix.py``).
+
+Slow-marked like the base suite: off-TPU the fused kernels run through
+the Pallas interpreter, which turns each Miller-loop step into a
+grid-loop of dynamic slices — minutes, not seconds.
+"""
+
+import numpy as np
+import pytest
+
+from test_device_pairing import *  # noqa: F401,F403
+
+pytestmark = pytest.mark.slow
+
+from lighthouse_tpu.crypto.device import fp2 as _fp2
+from lighthouse_tpu.crypto.device import pairing as _pairing
+
+
+@pytest.fixture(autouse=True)
+def _fused_engines():
+    with _fp2.impl(_fp2.IMPL_FUSED_PALLAS), \
+            _pairing.line_impl(_pairing.IMPL_LINE_FUSED):
+        yield
+
+
+def test_staged_verify_headline_rung_fused_zero_steady_recompiles():
+    """Full staged verify at the headline rung (64, 16, 8) under the
+    fused engines: verdict must match the composed gate's and the SECOND
+    dispatch at the same shape must tick zero recompiles — the fused
+    kernel surface may not perturb steady-state shape stability."""
+    import lighthouse_tpu.crypto.device as device
+    from lighthouse_tpu.crypto import bls as hbls
+    from lighthouse_tpu.crypto.device.bls import (
+        pack_signature_sets_raw,
+        verify_batch_raw_staged,
+    )
+    from lighthouse_tpu.crypto.params import R
+    from lighthouse_tpu.utils import metrics
+
+    B, K, M = 64, 16, 8
+    sks = [hbls.SecretKey(77 + i) for i in range(2)]
+    pks = [sk.public_key().point for sk in sks]
+    m1, m2 = b"\x31" * 32, b"\x32" * 32
+    agg_sk = hbls.SecretKey((77 + 78) % R)
+    sets = [
+        (hbls.Signature.deserialize(sks[0].sign(m1).serialize()), [pks[0]], m1),
+        (hbls.Signature.deserialize(agg_sk.sign(m2).serialize()), pks, m2),
+    ]
+    device.reset_compiled_state()
+    try:
+        args = pack_signature_sets_raw(sets, pad_b=B, pad_k=K, pad_m=M)
+        ok = verify_batch_raw_staged(*args)
+        assert bool(ok) is True
+        rec = metrics.get("bls_device_recompiles_total")
+        before = {
+            s: rec.with_labels(s).value for s in ("stage1", "stage2", "stage3")
+        }
+        ok2 = verify_batch_raw_staged(*args)
+        assert bool(ok2) is True
+        after = {
+            s: rec.with_labels(s).value for s in ("stage1", "stage2", "stage3")
+        }
+        assert after == before, (
+            f"steady-state dispatch under the fused engines recompiled: "
+            f"{before} -> {after}"
+        )
+    finally:
+        device.reset_compiled_state()

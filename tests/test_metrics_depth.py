@@ -83,6 +83,9 @@ def test_op_pool_and_slasher_families():
 
 
 def test_sync_families_registered():
+    # the network service imports the libp2p stack, which needs the
+    # optional `cryptography` wheel (same guard as the network suites)
+    pytest.importorskip("cryptography")
     # registration happens at import; presence in the exposition is the
     # contract the dashboards depend on
     import lighthouse_tpu.network.service  # noqa: F401

@@ -1,0 +1,49 @@
+"""Staged-program SIZE regression gate under the FUSED engines
+(ISSUE 16): the budgets of ``test_zgate2_compile_budget.py`` re-pinned
+with ``FP2_IMPL=fused_pallas`` + ``LINE_IMPL=fused`` active, so growing
+the Pallas kernel surface cannot silently balloon the flagship staged
+programs.
+
+Measured counts at B=4/K=2/M=2 off-TPU (stage1 33,528 / stage2 13,488 /
+stage3 33,263) plus ~25% headroom. The fused counts sit ABOVE the
+composed ones here because off-TPU the ``pallas_call`` lowers through
+the interpreter (a grid loop of dynamic slices in StableHLO); on TPU the
+same call lowers to one Mosaic custom-call and the counts drop, so these
+budgets are a conservative ceiling for both lowerings. Budgets are
+deliberately separate from the composed gate's — raising one must never
+hide drift in the other.
+
+Named ``test_zgate2_*`` (tail-sorted right after the composed gate) for
+the same wall-clock reason: size gates collect after functional
+coverage, before the compile-heavy zgate3 dispatch gates.
+"""
+
+import jax
+
+from lighthouse_tpu.crypto.device import fp2, pairing
+from tools.hlo_stats import staged_instruction_counts
+
+FUSED_BUDGETS = {"stage1": 42_000, "stage2": 17_000, "stage3": 42_000}
+
+
+def test_staged_hlo_instruction_budget_fused_engines():
+    # jit lowering caches on function identity, not on the engine seams
+    # (dispatch is trace-time): clear so the fused trace is actually
+    # measured, and clear again so no fused trace leaks to later tests.
+    jax.clear_caches()
+    try:
+        with fp2.impl(fp2.IMPL_FUSED_PALLAS), \
+                pairing.line_impl(pairing.IMPL_LINE_FUSED):
+            counts = staged_instruction_counts(B=4, K=2, M=2)
+    finally:
+        jax.clear_caches()
+    assert set(counts) == set(FUSED_BUDGETS)
+    for stage, rec in counts.items():
+        n = rec["instructions"]
+        assert n > 0, f"{stage}: instruction count unavailable"
+        assert n <= FUSED_BUDGETS[stage], (
+            f"{stage} grew to {n} HLO instructions under the fused "
+            f"engines (budget {FUSED_BUDGETS[stage]}); compile time "
+            f"scales with this — shrink the kernel surface (scan the "
+            f"new structure) or consciously raise the budget here"
+        )

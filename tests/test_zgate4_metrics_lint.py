@@ -571,6 +571,7 @@ def test_warmup_tool_imports_and_dry_run_lists_ladder(capsys, monkeypatch):
         raise AssertionError("--dry-run must not compile")
 
     monkeypatch.setattr(lowering, "warm_staged", boom)
+    monkeypatch.setattr(lowering, "warm_msm", boom)
     monkeypatch.setattr(lowering, "timed_lower_compile", boom)
     # the operator knob must not leak into the DEFAULT_RUNGS assertion
     monkeypatch.delenv("LIGHTHOUSE_TPU_COMPILE_RUNGS", raising=False)
@@ -584,6 +585,13 @@ def test_warmup_tool_imports_and_dry_run_lists_ladder(capsys, monkeypatch):
     assert "gathered rungs" in out, out
     for b, k in sorted({(b, k) for (b, k, _m) in DEFAULT_RUNGS}):
         assert f"gather B={b} K={k}" in out, out
+    # ISSUE 16: the MSM ladder (opt-in device aggregation programs) is
+    # listed too — same honesty contract as the gather rungs
+    from lighthouse_tpu.compile_service.service import MSM_RUNGS
+
+    assert "msm rungs" in out, out
+    for n in MSM_RUNGS:
+        assert f"msm N={n}" in out, out
     # an explicit plan overrides the default and is echoed verbatim
     assert warmup.main(["--dry-run", "--rungs", "4:1:1"]) == 0
     out = capsys.readouterr().out

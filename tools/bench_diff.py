@@ -114,6 +114,26 @@ METRICS = (
     ("bulk_sets_per_sec", ("bulk_leg", "bulk_sets_per_sec"), True),
     ("bulk_throttle_excursions",
      ("bulk_leg", "throttle_excursions"), None),
+    # ISSUE 16: the kernel-surface families (BENCH_KERNELS.json, also
+    # diffable directly: two kernel artifacts compare on these paths).
+    # LEARNED, never gated: off-TPU the fused engines run the Pallas
+    # kernels in interpreter mode, so CPU rates are semantics checks —
+    # only a backend-tpu pair makes these speed comparisons meaningful
+    ("kernel_fp2_mul_composed_mac_per_sec",
+     ("kernels", "fp2_mul", "impls", "composed", "mac_per_sec"), True),
+    ("kernel_fp2_mul_fused_mac_per_sec",
+     ("kernels", "fp2_mul", "impls", "fused_pallas", "mac_per_sec"), True),
+    ("kernel_fp2_sq_composed_mac_per_sec",
+     ("kernels", "fp2_sq", "impls", "composed", "mac_per_sec"), True),
+    ("kernel_fp2_sq_fused_mac_per_sec",
+     ("kernels", "fp2_sq", "impls", "fused_pallas", "mac_per_sec"), True),
+    ("kernel_line_dbl_composed_mac_per_sec",
+     ("kernels", "line_dbl", "impls", "composed", "mac_per_sec"), True),
+    ("kernel_line_dbl_fused_mac_per_sec",
+     ("kernels", "line_dbl", "impls", "fused", "mac_per_sec"), True),
+    ("kernel_msm_g1_point_adds_per_sec",
+     ("kernels", "msm_g1", "impls", "windowed_g1", "point_adds_per_sec"),
+     True),
 )
 
 # the metrics whose regression exits nonzero (ISSUE 8 throughput/waste
@@ -138,7 +158,11 @@ def load_bench(path: str) -> dict:
         doc = json.load(f)
     if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
         doc = doc["parsed"]
-    if not isinstance(doc, dict) or "value" not in doc:
+    # a kernel-family artifact (BENCH_KERNELS.json, ISSUE 16) has no
+    # headline 'value' but is diffable on the kernel_* metrics
+    if not isinstance(doc, dict) or (
+        "value" not in doc and "kernels" not in doc
+    ):
         raise ValueError(
             f"{path}: not a bench artifact (no headline 'value' field)"
         )

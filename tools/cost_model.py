@@ -220,6 +220,40 @@ def main() -> None:
               "above is only validated by a run with backend `tpu`; a CPU "
               "ratio measures XLA:CPU's int8 vs int32 vectorization.")
         w("")
+    # Measured kernel-family constants (ISSUE 16): the grown Pallas
+    # surface — fused fp2 tower ops, Miller-loop line-eval, windowed G1
+    # MSM — benched per engine with cross-engine byte-identity pinned
+    # inside the bench itself.
+    kpath = REPO / "BENCH_KERNELS.json"
+    if kpath.exists():
+        km = json.loads(kpath.read_text())
+        w("## Measured kernel-family throughput (benches/bench_fp_mul.py "
+          "--families, BENCH_KERNELS.json)")
+        w("")
+        w(f"Backend `{km['backend']}` (fp impl `{km['fp_impl']}`), median "
+          f"of {km['reps']} reps per engine. fp2/line rows are MAC/s over "
+          "the family's fp-lane count (fp2 mul = 3 lanes, sq = 2, "
+          "line-eval doubling step = 31); the MSM row is point-adds/s "
+          "over the masked bucket-reduction lanes (N x 16 windows x 15 "
+          "buckets). Off-TPU the `fused_pallas`/`fused` engines run the "
+          "Pallas kernels in interpreter mode — their CPU rows are "
+          "semantics checks, not speed claims; only a backend `tpu` run "
+          "measures the fusion win. Cross-engine sha256 byte-identity of "
+          "canonical outputs is asserted by the bench before any rate is "
+          "reported.")
+        w("")
+        w("| kernel | shape | engine | rate | step_s | compile_s |")
+        w("|---|---|---|---|---|---|")
+        for kname, krec in km["kernels"].items():
+            shape = f"N={krec['n']}" + (
+                f" depth={krec['depth']}" if "depth" in krec else ""
+            )
+            for ename, r in krec["impls"].items():
+                rate = r.get("mac_per_sec", r.get("point_adds_per_sec"))
+                unit = "MAC/s" if "mac_per_sec" in r else "adds/s"
+                w(f"| {kname} | {shape} | {ename} | {rate:.3e} {unit} | "
+                  f"{r['step_s']:.5f} | {r['compile_s']} |")
+        w("")
     # Data-movement table (ISSUE 8): the shared byte model
     # (utils/transfer_ledger.operand_bytes_model, pinned against the raw
     # packer's actual ndarray.nbytes by tests/test_transfer_ledger.py) at

@@ -130,6 +130,18 @@ def main(argv=None) -> int:
         )
         for b, k in gather_rungs:
             print(f"  gather B={b} K={k}")
+        # MSM ladder (ISSUE 16): opt-in (ClientConfig.device_msm), warmed
+        # in-node alongside the first staged rung per (impl, device).
+        # Keyed on the point axis only — never perturbs the staged
+        # shapes above. Each rung warms BOTH programs of the pair (G1
+        # windowed MSM + G2 point-sum).
+        print(
+            f"msm rungs (device aggregation MSM/G2-sum pair, warmed "
+            f"in-node when device_msm is enabled; "
+            f"{len(csvc_mod.MSM_RUNGS)} rungs x 2 programs):"
+        )
+        for n in csvc_mod.MSM_RUNGS:
+            print(f"  msm N={n}")
         print(f"cache_dir: {cache_dir or '(none — nothing would persist)'}")
         return 0
 
