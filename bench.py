@@ -822,6 +822,90 @@ def measure_capacity_leg(
     }
 
 
+def measure_epoch_flood_leg(
+    use_cpu: bool,
+    seed: int = 7,
+    duration_s: float = 12.0,
+    time_scale: float = 0.25,
+    deadline_ms: float = 50.0,
+    slot_s: float = 2.0,
+) -> dict:
+    """Slot-aligned epoch-flood leg (ISSUE 17): replay the canonical
+    ``epoch_boundary_flood`` trace with the chain-time axis on and
+    score WHERE in chain time the tail lives — the per-slot p99 spread
+    between the flood slots and the quiet slots, plus the committee
+    first-sighting hit ratio (ROADMAP item 3's go/no-go dial: the
+    flood's committee tuples repeat, so most sightings should collapse
+    to cache hits). Stub-backend subprocess (seconds): the leg measures
+    slot ATTRIBUTION under load, not crypto. Both headline numbers are
+    LEARNED, not gated, by ``tools/bench_diff.py``."""
+    replay = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools",
+        "traffic_replay.py",
+    )
+    leg_timeout = min(120.0, _budget_left() - 60)
+    if leg_timeout < 30:
+        return {"skipped": "budget"}
+    env = dict(os.environ)
+    if use_cpu:
+        env["JAX_PLATFORMS"] = "cpu"
+    try:
+        r = subprocess.run(
+            [sys.executable, replay,
+             "--generate", "epoch_boundary_flood", "--seed", str(seed),
+             "--duration", str(duration_s),
+             "--time-scale", str(time_scale),
+             "--deadline-ms", str(deadline_ms),
+             "--slot-s", str(slot_s),
+             "--verify", "stub:0.0005", "--json"],
+            capture_output=True, text=True, timeout=leg_timeout, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return {"skipped": f"timeout>{leg_timeout:.0f}s"}
+    if r.returncode != 0:
+        return {"error": f"rc={r.returncode}: {r.stderr[-200:]}"}
+    try:
+        report = json.loads(r.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError):
+        return {"error": f"unparseable output: {r.stdout[-200:]}"}
+    slots = [s for s in report.get("slots", []) if s["sets"]]
+    if not slots:
+        return {"error": "no slot cards in replay report"}
+    # flood slots by demand, not by position: the flood window's cards
+    # carry well over the median per-slot set count
+    counts = sorted(s["sets"] for s in slots)
+    median_sets = counts[len(counts) // 2]
+    flood = [s for s in slots if s["sets"] > 2 * median_sets]
+    quiet = [s for s in slots if s not in flood]
+    p99s = [s["p99_ms"] for s in slots if s["p99_ms"] is not None]
+    ct = report.get("chain_time", {})
+    return {
+        "generator": "epoch_boundary_flood",
+        "seed": seed,
+        "slot_s": slot_s,
+        "time_scale": time_scale,
+        "n_slots": len(slots),
+        "flood_slots": sorted(s["slot"] for s in flood),
+        "flood_sets": sum(s["sets"] for s in flood),
+        "quiet_sets": sum(s["sets"] for s in quiet),
+        "flood_p99_ms": (
+            round(max(s["p99_ms"] for s in flood), 3) if flood else None
+        ),
+        "quiet_p99_ms": (
+            round(
+                sorted(s["p99_ms"] for s in quiet)[len(quiet) // 2], 3
+            ) if quiet else None
+        ),
+        "p99_spread_ms": (
+            round(max(p99s) - min(p99s), 3) if len(p99s) > 1 else 0.0
+        ),
+        "misses_in_flood_slots": sum(s["misses"] for s in flood),
+        "misses_total": sum(s["misses"] for s in slots),
+        "committee_sightings": ct.get("committee_sightings"),
+        "first_sighting_hit_ratio": ct.get("first_sighting_hit_ratio"),
+    }
+
+
 def measure_chaos_leg(
     use_cpu: bool,
     generator: str = "gossip_steady",
@@ -1498,6 +1582,18 @@ def main() -> None:
         except Exception as e:  # the leg must not kill the line
             bulk_leg = {"error": str(e)[:200]}
 
+    # Slot-aligned epoch-flood leg (ISSUE 17): per-slot p99 spread
+    # between flood and quiet slots + the committee first-sighting hit
+    # ratio on the canonical flood trace — stub-backend subprocess,
+    # seconds. Both headline numbers are learned by bench_diff.
+    if _budget_left() < 90:
+        epoch_flood_leg = {"skipped": "budget"}
+    else:
+        try:
+            epoch_flood_leg = measure_epoch_flood_leg(use_cpu)
+        except Exception as e:  # the leg must not kill the line
+            epoch_flood_leg = {"error": str(e)[:200]}
+
     # Served multi-chip dp verify, 1 vs 2 virtual devices (ISSUE 11):
     # per-chip + aggregate sets/s through the real scheduler/planner/
     # backend stack. Subprocesses (XLA_FLAGS must precede jax init),
@@ -1599,6 +1695,7 @@ def main() -> None:
                 "capacity_leg": capacity_leg,
                 "chaos_leg": chaos_leg,
                 "bulk_leg": bulk_leg,
+                "epoch_flood_leg": epoch_flood_leg,
                 "dp_leg": dp_leg,
                 "startup": startup,
                 "buckets": buckets,

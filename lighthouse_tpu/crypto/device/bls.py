@@ -762,6 +762,20 @@ def device_msm_g1(points, scalars, pad_n: int | None = None):
         sw[i] = np.array(
             [(s >> 32) & 0xFFFFFFFF, s & 0xFFFFFFFF], np.uint32
         ).view(np.int32)
+    # data-movement attribution (ISSUE 17 satellite: msm can't run
+    # dark): live lanes count as point/scalar bytes, pad lanes as
+    # padding — the labels sum to the exact device_put nbytes, the
+    # transfer ledger's invariant
+    live = len(pts)
+    live_b = live * (xy.nbytes // N + inf.nbytes // N) + live * (sw.nbytes // N)
+    transfer_ledger.note_op_bytes(
+        {
+            "pubkeys": live * (xy.nbytes // N + inf.nbytes // N),
+            "aux": live * (sw.nbytes // N),
+            "padding": xy.nbytes + inf.nbytes + sw.nbytes - live_b,
+        },
+        kind="msm",
+    )
     oxy, oinf = run_msm_g1(
         jnp.asarray(xy), jnp.asarray(inf), jnp.asarray(sw)
     )
@@ -780,6 +794,16 @@ def device_sum_g2(points, pad_n: int | None = None):
         pxy, pinf = curve.pack_g2(pts)
         xy[: len(pts)] = pxy
         inf[: len(pts)] = pinf
+    # G2 points ride the signatures operand (they ARE signature points
+    # — the op pool's aggregation inputs); pad lanes as padding
+    live_b = len(pts) * (xy.nbytes // N + inf.nbytes // N)
+    transfer_ledger.note_op_bytes(
+        {
+            "signatures": live_b,
+            "padding": xy.nbytes + inf.nbytes - live_b,
+        },
+        kind="msm",
+    )
     oxy, oinf = run_g2_sum(jnp.asarray(xy), jnp.asarray(inf))
     return curve.unpack_g2(np.asarray(oxy)[None], np.asarray(oinf)[None])[0]
 

@@ -63,7 +63,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ...utils import fault_injection, flight_recorder, metrics
+from ...utils import fault_injection, flight_recorder, metrics, slot_ledger
 
 # limbs per field element; pinned == fp.NL by test (this module must not
 # import the device fp module, which pulls jax)
@@ -651,9 +651,17 @@ class DeviceKeyTable:
                     if slot >= 0:
                         self._agg_hits += 1
                         _AGG_EVENTS.with_labels("hit").inc()
+                        # chain-time (ISSUE 17): a collapsed K=1 row
+                        # served this committee — the numerator of the
+                        # per-epoch first-sighting dial
+                        slot_ledger.note_committee_sighting("hit")
                         hits[j] = slot
                         continue
                     _AGG_EVENTS.with_labels("miss").inc()
+                    # first sighting: the host EC sum territory — the
+                    # denominator's other half (first + hits = committee
+                    # sightings, conservation-pinned)
+                    slot_ledger.note_committee_sighting("first")
                     miss_positions.setdefault(key, []).append(j)
                     if len(self._agg_seen) >= _AGG_SEEN_CAP:
                         self._agg_seen.clear()

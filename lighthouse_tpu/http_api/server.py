@@ -46,6 +46,7 @@ consume):
     GET  /eth/v1/debug/beacon/heads
     GET  /lighthouse/health
     GET  /lighthouse/timeseries (?family=&window=&tier= filters)
+    GET  /lighthouse/slots (?view=slots|epochs, ?last=N)
     GET  /metrics
 """
 
@@ -472,6 +473,14 @@ class BeaconApiServer:
             from ..utils import timeseries
 
             doc["capacity"] = timeseries.capacity_summary()
+            # chain-time attribution (ISSUE 17): the slot ledger's
+            # rollup state — current slot/epoch, retained report cards,
+            # lifetime totals and the latest epoch's first-sighting
+            # ratio (ROADMAP item 3's go/no-go dial); per-slot cards at
+            # /lighthouse/slots, rendered by tools/slot_report.py
+            from ..utils import slot_ledger
+
+            doc["chain_time"] = slot_ledger.summary()
             return {"data": doc}
         if path == "/lighthouse/flight_recorder":
             # live journal tail: ?kind=a,b filters, ?limit=N bounds the
@@ -524,6 +533,41 @@ class BeaconApiServer:
                 raise ApiError(400, str(e))
             doc["estimate"] = timeseries.last_estimate()
             return {"data": doc}
+        if path == "/lighthouse/slots":
+            # per-slot report cards (ISSUE 17): ?view=slots (default)
+            # serves the retained slot cards, ?view=epochs the epoch
+            # first-sighting rollup; ?last=N keeps only the N newest
+            # rows. Lifetime + evicted totals ride along so a reader
+            # can verify conservation (retained + evicted == lifetime)
+            # from one fetch.
+            from ..utils import slot_ledger
+
+            view = query.get("view", "slots")
+            if view not in ("slots", "epochs"):
+                raise ApiError(400, "malformed view parameter")
+            last = None
+            if "last" in query:
+                try:
+                    last = int(query["last"])
+                except ValueError:
+                    raise ApiError(400, "malformed last parameter")
+                if last < 0:
+                    raise ApiError(400, "malformed last parameter")
+            rows = (
+                slot_ledger.slot_cards(last=last)
+                if view == "slots"
+                else slot_ledger.epoch_cards(last=last)
+            )
+            return {
+                "data": {
+                    "schema": slot_ledger.SCHEMA,
+                    "view": view,
+                    "chain_time": slot_ledger.summary(),
+                    "rows": rows,
+                    "lifetime": slot_ledger.lifetime_totals(),
+                    "evicted": slot_ledger.evicted_totals(),
+                }
+            }
 
 
         m = re.fullmatch(r"/eth/v1/beacon/states/([^/]+)/root", path)

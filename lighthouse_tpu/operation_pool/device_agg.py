@@ -21,7 +21,9 @@ broken accelerator can only ever cost the speedup.
 
 from __future__ import annotations
 
-from ..utils import metrics
+import time
+
+from ..utils import flight_recorder, metrics, tracing
 
 _AGG = metrics.counter_vec(
     "op_pool_device_agg_total",
@@ -66,15 +68,35 @@ class DeviceAggregator:
         if len(sigs) < self.min_batch:
             _AGG.with_labels("small").inc()
             return None
+        pad_n = self._pad_n(len(sigs))
+        t0 = time.perf_counter()
         try:
-            pts = [s.point_or_infinity() for s in sigs]
-            from ..crypto.device import bls as dbls
+            with tracing.span(
+                "op_pool.device_agg", n_points=len(sigs), pad_n=pad_n
+            ):
+                pts = [s.point_or_infinity() for s in sigs]
+                from ..crypto.device import bls as dbls
 
-            out = dbls.device_sum_g2(pts, pad_n=self._pad_n(len(pts)))
-        except Exception:
+                out = dbls.device_sum_g2(pts, pad_n=pad_n)
+        except Exception as e:
             _AGG.with_labels("fallback").inc()
+            flight_recorder.record(
+                "op_pool_device_agg",
+                outcome="fallback",
+                n_points=len(sigs),
+                pad_n=pad_n,
+                wall_s=round(time.perf_counter() - t0, 6),
+                error=str(e)[:200],
+            )
             return None
         _AGG.with_labels("ok").inc()
+        flight_recorder.record(
+            "op_pool_device_agg",
+            outcome="ok",
+            n_points=len(sigs),
+            pad_n=pad_n,
+            wall_s=round(time.perf_counter() - t0, 6),
+        )
         if out.is_infinity():
             # the canonical infinity encoding, exactly like the host
             # fold's untouched AggregateSignature.infinity()

@@ -70,6 +70,7 @@ EVENT_KINDS = (
     "key_table_reset",        # crypto/device/key_table.py, agg region recycle
     "key_table_sync",         # crypto/device/key_table.py, startup/delta rows
     "log",                    # utils/logging.py, warn/error/crit lines
+    "op_pool_device_agg",     # operation_pool/device_agg.py, per device merge
     "peer_ban",               # network/peer_manager.py
     "peer_penalty",           # network/peer_manager.py
     "pipeline_flush",         # utils/pipeline_profiler.py, one per flush
@@ -170,6 +171,13 @@ def record(kind: str, /, **fields) -> None:
         _ring[_seq % _capacity] = ev
         _seq += 1
     _EVENTS_TOTAL.with_labels(kind).inc()
+    if kind.endswith("_rejected"):
+        # chain-time attribution: every journal rejection lands on its
+        # slot's report card (utils.slot_ledger imports neither this
+        # module nor anything jax-shaped — no cycle)
+        from . import slot_ledger
+
+        slot_ledger.note_rejection(kind)
     if _subscribers:
         _notify(ev)
 

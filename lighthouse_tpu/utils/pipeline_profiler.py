@@ -73,7 +73,7 @@ import time
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
-from . import flight_recorder, metrics
+from . import flight_recorder, metrics, slot_ledger
 
 # flush lifecycle phases, in timeline order (docs/OBSERVABILITY.md)
 FLUSH_PHASES = ("queue_wait", "plan", "pack", "device", "fallback", "resolve")
@@ -661,6 +661,11 @@ def note_stage_wall(
     if gap_attr:
         for cause, s in gap_attr.items():
             _BUBBLE_SECONDS.with_labels(str(shard), cause).inc(s)
+        # chain-time attribution: the bubble lands on the slot the gap
+        # CLOSED in (cause split stays in the counter family)
+        slot_ledger.note_bubble(sum(gap_attr.values()))
+    if fresh:
+        slot_ledger.note_fresh_compile(stage)
 
 
 # ---------------------------------------------------------------------------

@@ -1,23 +1,66 @@
 """Slot clocks (reference: ``common/slot_clock`` — trait at
 ``src/lib.rs:20``, ``SystemTimeSlotClock``, ``ManualSlotClock`` for
-tests)."""
+tests).
+
+Chain-time axis (ISSUE 17): every instrument in the measurement stack
+is keyed on wall-clock, but the workload that matters is keyed on the
+beacon chain's slot clock — committee batch-verification cost peaks at
+slot and epoch boundaries. This module is the jax-free resolution seam:
+genesis-anchored slot AND epoch math, plus a settable process-global
+clock (:func:`set_clock`) so replays can map trace-time → slot
+deterministically and every ``slot_ledger`` producer attributes to the
+same chain time without threading a clock through each call site.
+"""
 
 from __future__ import annotations
 
+import threading
 import time
+from typing import Optional
+
+# Mainnet constants — the defaults a clock gets when the caller does
+# not say otherwise. Replays install clocks scaled to their traces.
+DEFAULT_SECONDS_PER_SLOT = 12
+DEFAULT_SLOTS_PER_EPOCH = 32
 
 
 class SlotClock:
-    def __init__(self, genesis_time: int, seconds_per_slot: int):
+    def __init__(
+        self,
+        genesis_time: float,
+        seconds_per_slot: float,
+        slots_per_epoch: int = DEFAULT_SLOTS_PER_EPOCH,
+    ):
         self.genesis_time = genesis_time
         self.seconds_per_slot = seconds_per_slot
+        self.slots_per_epoch = max(1, int(slots_per_epoch))
 
     def now(self) -> int:
         """Current slot (0 before genesis)."""
-        t = self._unix_time()
+        return self.slot_at(self._unix_time())
+
+    def slot_at(self, t: float) -> int:
+        """Slot containing unix time ``t`` (0 before genesis) — the
+        genesis-anchored resolution replays use to map a trace
+        timestamp onto chain time."""
         if t < self.genesis_time:
             return 0
-        return int(t - self.genesis_time) // self.seconds_per_slot
+        return int(t - self.genesis_time) // int(self.seconds_per_slot) \
+            if float(self.seconds_per_slot).is_integer() \
+            else int((t - self.genesis_time) / self.seconds_per_slot)
+
+    def epoch_of(self, slot: int) -> int:
+        """Epoch containing ``slot``."""
+        return int(slot) // self.slots_per_epoch
+
+    def epoch_at(self, t: float) -> int:
+        return self.epoch_of(self.slot_at(t))
+
+    def current_epoch(self) -> int:
+        return self.epoch_of(self.now())
+
+    def first_slot_of_epoch(self, epoch: int) -> int:
+        return int(epoch) * self.slots_per_epoch
 
     def seconds_into_slot(self) -> float:
         t = self._unix_time()
@@ -42,8 +85,13 @@ class SystemTimeSlotClock(SlotClock):
 class ManualSlotClock(SlotClock):
     """Test clock: advanced explicitly (reference ManualSlotClock)."""
 
-    def __init__(self, genesis_time: int = 0, seconds_per_slot: int = 12):
-        super().__init__(genesis_time, seconds_per_slot)
+    def __init__(
+        self,
+        genesis_time: float = 0,
+        seconds_per_slot: float = 12,
+        slots_per_epoch: int = DEFAULT_SLOTS_PER_EPOCH,
+    ):
+        super().__init__(genesis_time, seconds_per_slot, slots_per_epoch)
         self._now = float(genesis_time)
 
     def set_slot(self, slot: int) -> None:
@@ -57,3 +105,43 @@ class ManualSlotClock(SlotClock):
 
     def _unix_time(self) -> float:
         return self._now
+
+
+# ---------------------------------------------------------------------------
+# Process-global clock seam (ISSUE 17)
+# ---------------------------------------------------------------------------
+#
+# The slot ledger's producers (scheduler, transfer ledger, pipeline
+# profiler, key table, …) attribute events to "the current slot" — ONE
+# clock per process, replaceable for replays. The default is a
+# mainnet-parameter system clock anchored at unix epoch 0, so slots are
+# globally meaningful absolute numbers until something more specific is
+# installed.
+
+_clock_lock = threading.Lock()
+_global_clock: Optional[SlotClock] = None
+
+
+def get_clock() -> SlotClock:
+    """The process-global slot clock (created lazily with mainnet
+    parameters when nothing was installed)."""
+    global _global_clock
+    with _clock_lock:
+        if _global_clock is None:
+            _global_clock = SystemTimeSlotClock(
+                genesis_time=0,
+                seconds_per_slot=DEFAULT_SECONDS_PER_SLOT,
+                slots_per_epoch=DEFAULT_SLOTS_PER_EPOCH,
+            )
+        return _global_clock
+
+
+def set_clock(clock: Optional[SlotClock]) -> Optional[SlotClock]:
+    """Install ``clock`` as the process-global slot clock (None resets
+    to the lazy default); returns the previous clock so callers can
+    restore it — the replay drivers' install/restore discipline."""
+    global _global_clock
+    with _clock_lock:
+        prev = _global_clock
+        _global_clock = clock
+        return prev

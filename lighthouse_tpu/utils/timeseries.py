@@ -76,7 +76,7 @@ import time
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
-from . import flight_recorder, metrics
+from . import flight_recorder, metrics, slot_ledger
 
 SCHEMA = "lighthouse_tpu.timeseries/1"
 
@@ -153,6 +153,14 @@ SAMPLE_FAMILIES: Tuple[FamilySpec, ...] = (
     # series it is held against
     FamilySpec("capacity_verdict_sets_per_sec", "rate",
                "verification_scheduler_sets_total", "kind"),
+    # chain-time slot ledger (ISSUE 17): the per-epoch first-sighting
+    # hit ratio (ROADMAP item 3's go/no-go dial) as history, plus the
+    # ledger's own event throughput so a dashboard can see attribution
+    # coverage move with load
+    FamilySpec("slot_first_sighting_hit_ratio", "gauge",
+               "key_table_first_sighting_hit_ratio", "epoch"),
+    FamilySpec("slot_ledger_events_per_sec", "rate",
+               "slot_ledger_events_total", "event"),
 )
 
 # ---------------------------------------------------------------------------
@@ -737,6 +745,10 @@ def estimate_capacity(
             _UTILIZATION.set(utilization)
         if headroom is not None:
             _HEADROOM.set(headroom)
+            # chain-time: the slot's report card keeps its MINIMUM
+            # headroom — the worst moment inside the slot, the per-slot
+            # resolution ROADMAP item 1's "throughout" claims need
+            slot_ledger.note_headroom(headroom)
     return doc
 
 

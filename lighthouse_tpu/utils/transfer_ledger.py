@@ -69,7 +69,7 @@ import time
 from collections import deque
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
-from . import flight_recorder, metrics
+from . import flight_recorder, metrics, slot_ledger
 
 # ---------------------------------------------------------------------------
 # Byte model (int32 limb layout, crypto/device/fp.py: NL=32 12-bit limbs)
@@ -458,6 +458,10 @@ def note_pack(
     for op, nb in by_operand.items():
         if nb:
             _H2D_BYTES.with_labels(op, kind).inc(nb)
+    if total_bytes:
+        # chain-time attribution: the slot's report card carries the
+        # byte total (operand split stays in the counter family)
+        slot_ledger.note_h2d_bytes(total_bytes)
 
     entries = [
         (pubkey_digest(blob), len(blob)) for blob in pubkey_blobs
@@ -532,6 +536,29 @@ def commit_verify(verdict: Optional[bool], d2h_bytes: int = 1) -> None:
         # still lands: the pack's bytes were real)
         verdict=None if verdict is None else bool(verdict),
     )
+
+
+def note_op_bytes(operand_nbytes: Dict[str, int], kind: Optional[str] = None) -> None:
+    """Standalone device-op H2D attribution for dispatches that are NOT
+    a signature-set pack — the MSM-stage host helpers (``device_msm_g1``
+    ships G1 points + scalars, ``device_sum_g2`` ships G2 points; ISSUE
+    17 satellite: "msm can't run dark"). Ticks the same
+    ``bls_device_h2d_bytes_total{operand,kind}`` family against the
+    current attribution context (or an explicit ``kind``) and lands the
+    byte total in the slot ledger. No journal row and no re-upload
+    sketch: those are per-verify surfaces, and an MSM dispatch is not a
+    verify."""
+    if not _enabled:
+        return
+    k = kind if kind is not None else current_context()[0]
+    total = 0
+    for op, nb in operand_nbytes.items():
+        nb = int(nb)
+        if nb:
+            _H2D_BYTES.with_labels(op, k).inc(nb)
+            total += nb
+    if total:
+        slot_ledger.note_h2d_bytes(total)
 
 
 def record_cpu(n_sets: int, kind: Optional[str] = None,

@@ -161,6 +161,7 @@ from ..utils import (
     flight_recorder,
     metrics,
     pipeline_profiler,
+    slot_ledger,
     tracing,
     transfer_ledger,
 )
@@ -529,6 +530,9 @@ class VerificationScheduler:
         self._bulk_flushes = 0
         self._bulk_sets_flushed = 0
         self._bulk_shed = 0
+        # throttle-transition latch for chain-time parked accounting:
+        # one note per excursion, never per recheck poll
+        self._bulk_parked_noted = False
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._pending: deque[_Submission] = deque()
@@ -854,6 +858,15 @@ class VerificationScheduler:
             # never a cached flag (see _submit_bulk)
             if self._bulk_pending_sets or self._admission.throttled():
                 self._admission.evaluate()
+                # chain-time: on entering a throttle excursion, the sets
+                # sitting in the bulk queue are PARKED — attributed once
+                # per excursion to the slot the valve closed in
+                throttled_now = self._admission.throttled()
+                if throttled_now and not self._bulk_parked_noted:
+                    parked = self._bulk_pending_sets
+                    if parked:
+                        slot_ledger.note_bulk(parked_sets=parked)
+                self._bulk_parked_noted = throttled_now
             trigger = None
             bulk = False
             with self._cv:
@@ -988,6 +1001,9 @@ class VerificationScheduler:
         if qos == "bulk":
             self._bulk_flushes += 1
             self._bulk_sets_flushed += n_sets
+            # chain-time: sets the admission governor let through, on
+            # the slot the flush ran in
+            slot_ledger.note_bulk(admitted_sets=n_sets)
         # pipeline profiler (ISSUE 12): one lifecycle record per flush —
         # queue-wait (the oldest submission's), plan, pack, device and
         # fallback walls accumulate from this thread and the dp workers
@@ -1489,6 +1505,13 @@ class VerificationScheduler:
         missed = qos == "deadline" and latency_s > budget_s
         _VERDICT_LATENCY.with_labels(kind, path).observe(latency_s)
         self._slo.observe(kind, path, latency_s, missed, qos=qos)
+        # chain-time attribution (ISSUE 17): THIS is the one point every
+        # resolution path funnels through (_account ← _finish, for
+        # planned / bisection / shed / bulk / fallback alike), so the
+        # slot's report card counts each submission exactly once
+        slot_ledger.note_resolution(
+            kind, path, n_sets, latency_s, missed=missed, qos=qos
+        )
         if missed:
             _DEADLINE_MISSES.with_labels(kind).inc()
             flight_recorder.record(

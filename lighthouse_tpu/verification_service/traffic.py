@@ -90,6 +90,21 @@ def _validate_event(ev: dict, lineno: int) -> dict:
             f"trace line {lineno}: qos=bulk is submit-only (the "
             f"verify_now bypass is the latency-critical class)"
         )
+    # optional committee identity (ISSUE 17): the validator-index tuple
+    # an aggregate's signers form — what the aggregate-cache collapse
+    # keys on and the replay's first-sighting model consumes
+    if "validators" in out:
+        try:
+            vals = [int(v) for v in out["validators"]]
+        except (TypeError, ValueError) as e:
+            raise ValueError(
+                f"trace line {lineno}: malformed validators in {ev!r}: {e}"
+            )
+        if any(v < 0 for v in vals):
+            raise ValueError(
+                f"trace line {lineno}: negative validator index in {ev!r}"
+            )
+        out["validators"] = vals
     return out
 
 
@@ -266,6 +281,7 @@ def epoch_boundary_flood(
     flood_width_s: float = 2.0,
     flood_factor: float = 8.0,
     block_sets: int = 2,
+    n_committees: int = 16,
 ) -> List[dict]:
     """The acceptance-gate shape: gossip steady-state with an
     attestation FLOOD in the window starting at
@@ -274,7 +290,16 @@ def epoch_boundary_flood(
     reshuffle land together), plus one latency-critical block
     verification per slot on the ``verify_now`` bypass — the trace that
     exercises fused, planned, shed, bypass and fallback resolution
-    paths at once."""
+    paths at once.
+
+    Committee realism (ISSUE 17): a real epoch has a FIXED committee
+    shuffle — the same validator-index tuples recur across the epoch's
+    aggregates — so flood aggregates draw their ``validators`` tuple
+    from ``n_committees`` stable disjoint committees instead of being
+    anonymous. Repeated tuples are exactly what the aggregate-cache
+    collapse (key table, ROADMAP item 3) keys on; without them the
+    first-sighting hit-ratio is structurally unmeasurable on this
+    trace."""
     rng = random.Random(seed)
     evs = gossip_steady(
         duration_s=duration_s, seed=seed + 1, rate_scale=rate_scale,
@@ -285,6 +310,11 @@ def epoch_boundary_flood(
     # the flood rides ON TOP of the base rates (extra independent
     # streams), so the boundary window carries base + (factor-1)x extra
     extra = max(0.0, flood_factor - 1.0) * rate_scale
+    # the epoch's committee shuffle: stable disjoint index tuples
+    committees = [
+        tuple(range(c * committee, (c + 1) * committee))
+        for c in range(max(1, int(n_committees)))
+    ]
     evs += _poisson(
         rng, 40.0 * extra, f0, f1,
         lambda t, r: {"t": t, "kind": "unaggregated", "n_sets": 1,
@@ -293,7 +323,10 @@ def epoch_boundary_flood(
     evs += _poisson(
         rng, 12.0 * extra, f0, f1,
         lambda t, r: {"t": t, "kind": "aggregate", "n_sets": 1,
-                      "pubkeys": committee, "messages": 1, "path": "submit"},
+                      "pubkeys": committee, "messages": 1, "path": "submit",
+                      "validators": list(
+                          committees[r.randrange(len(committees))]
+                      )},
     )
     # one block per slot, early in the slot, on the synchronous bypass
     slot = 0
@@ -527,6 +560,9 @@ def lockstep_replay(
     shards: Optional[list] = None,
     bulk_flush_sets: int = 512,
     bulk_linger_ms: float = 100.0,
+    slot_s: float = 2.0,
+    slots_per_epoch: int = 32,
+    agg_min_repeats: int = 2,
 ) -> dict:
     """Deterministic virtual replay: walk the trace in arrival order and
     apply the scheduler's EXACT drain/flush policy (deadline measured
@@ -543,10 +579,45 @@ def lockstep_replay(
     (submission sequence, per-flush plan shapes, per-kind set counts,
     and a sha256 digest over all of it) is a pure function of (trace,
     parameters): the determinism property
-    ``tests/test_traffic_replay.py`` pins across processes."""
+    ``tests/test_traffic_replay.py`` pins across processes.
+
+    Chain-time (ISSUE 17): virtual trace time maps deterministically to
+    slots (``slot = t // slot_s``), and the report carries a per-slot
+    block — arrivals, sets, flushes and committee sightings per slot —
+    so a flood slot is individually visible instead of smeared into the
+    window average. Committee sightings model the key table's
+    aggregate-cache admission on events carrying ``validators``: a
+    tuple's first ``agg_min_repeats`` consults are ``first`` sightings
+    (host EC sum territory), every later one a collapsed ``hit``
+    (``DEFAULT_AGG_MIN_REPEATS`` in crypto/device/key_table.py). The
+    model is local and pure — the lockstep simulator never touches the
+    process-global slot ledger."""
     planner = planner or FlushPlanner()
     deadline_s = deadline_ms / 1000.0
     bulk_linger_s = bulk_linger_ms / 1000.0
+    slot_s = max(1e-9, float(slot_s))
+    slots_per_epoch = max(1, int(slots_per_epoch))
+    slots: Dict[int, dict] = {}
+    committee_seen: Dict[tuple, int] = {}
+    t_end = 0.0
+
+    def slot_row(t: float) -> dict:
+        s = int(t // slot_s)
+        row = slots.get(s)
+        if row is None:
+            row = slots[s] = {
+                "slot": s,
+                "epoch": s // slots_per_epoch,
+                "arrivals": 0,
+                "sets": 0,
+                "bypass_sets": 0,
+                "flushes": 0,
+                "flushed_sets": 0,
+                "bulk_sets": 0,
+                "sightings_first": 0,
+                "sightings_hit": 0,
+            }
+        return row
     pending: deque = deque()  # (ReplaySubmission, arrival t)
     pending_sets = 0
     bulk_pending: deque = deque()  # (ReplaySubmission, arrival t)
@@ -561,7 +632,9 @@ def lockstep_replay(
     set_totals: Dict[str, int] = {}
     bulk_set_total = 0
 
-    def drain_one(trigger: str, qos: str = "deadline") -> None:
+    def drain_one(
+        trigger: str, qos: str = "deadline", t: float = 0.0
+    ) -> None:
         nonlocal pending_sets, bulk_pending_sets, bulk_full_at
         bulk = qos == "bulk"
         queue = bulk_pending if bulk else pending
@@ -584,9 +657,15 @@ def lockstep_replay(
         plan = planner.plan(
             subs, warm_rungs=warm_rungs, shards=shards, qos=qos
         )
+        row = slot_row(t)
+        row["flushes"] += 1
+        row["flushed_sets"] += n
+        if bulk:
+            row["bulk_sets"] += n
         flushes.append({
             "trigger": trigger,
             "qos": qos,
+            "slot": row["slot"],
             "n_submissions": len(subs),
             "n_sets": n,
             "mode": plan.mode,
@@ -623,7 +702,7 @@ def lockstep_replay(
             if pending:
                 td = pending[0][1] + deadline_s
                 if td <= t_limit:
-                    drain_one("deadline")
+                    drain_one("deadline", t=td)
                     continue
                 return  # gossip pending blocks bulk past t_limit
             if bulk_pending:
@@ -632,13 +711,29 @@ def lockstep_replay(
                 else:
                     tb = bulk_pending[0][1] + bulk_linger_s
                 if tb <= t_limit:
-                    drain_one("bulk", qos="bulk")
+                    drain_one("bulk", qos="bulk", t=tb)
                     continue
             return
 
     for ev in sorted(events, key=lambda e: e["t"]):
         advance_to(ev["t"])
+        t_end = max(t_end, ev["t"])
+        row = slot_row(ev["t"])
+        row["arrivals"] += 1
+        row["sets"] += ev["n_sets"]
+        vals = ev.get("validators")
+        if vals and len(vals) > 1:
+            # the key table's admission policy, replayed pure: consult
+            # j of a tuple is a hit only once j > agg_min_repeats
+            key = tuple(vals)
+            prior = committee_seen.get(key, 0)
+            committee_seen[key] = prior + 1
+            if prior >= agg_min_repeats:
+                row["sightings_hit"] += 1
+            else:
+                row["sightings_first"] += 1
         if ev["path"] == "verify_now":
+            row["bypass_sets"] += ev["n_sets"]
             bypasses.append([ev["kind"], ev["n_sets"]])
             set_totals[ev["kind"]] = (
                 set_totals.get(ev["kind"], 0) + ev["n_sets"]
@@ -663,12 +758,15 @@ def lockstep_replay(
         pending_sets += ev["n_sets"]
         submissions.append([ev["kind"], ev["n_sets"]])
         while pending_sets >= max_batch_sets:
-            drain_one("full")
+            drain_one("full", t=ev["t"])
     while pending:
-        drain_one("shutdown")
+        drain_one("shutdown", t=t_end)
     while bulk_pending:
-        drain_one("shutdown", qos="bulk")
+        drain_one("shutdown", qos="bulk", t=t_end)
 
+    first_total = sum(r["sightings_first"] for r in slots.values())
+    hit_total = sum(r["sightings_hit"] for r in slots.values())
+    sighting_total = first_total + hit_total
     body = {
         "n_events": len(events),
         "deadline_ms": round(deadline_ms, 3),
@@ -683,6 +781,23 @@ def lockstep_replay(
             "sets_offered": bulk_set_total,
             "flushes": sum(1 for f in flushes if f["qos"] == "bulk"),
         },
+        # slot-aligned view (ISSUE 17): one row per virtual slot, so a
+        # flood slot is individually visible in the report and its
+        # digest
+        "chain_time": {
+            "slot_s": round(slot_s, 6),
+            "slots_per_epoch": slots_per_epoch,
+            "n_slots": len(slots),
+            "agg_min_repeats": agg_min_repeats,
+            "committee_sightings": sighting_total,
+            "first_sightings": first_total,
+            "sighting_hits": hit_total,
+            "first_sighting_hit_ratio": (
+                round(hit_total / sighting_total, 4)
+                if sighting_total else None
+            ),
+        },
+        "slots": [slots[s] for s in sorted(slots)],
     }
     digest = hashlib.sha256(
         json.dumps(body, sort_keys=True).encode()

@@ -1,0 +1,593 @@
+"""Chain-time observability (ISSUE 17): slot-clock epoch math and the
+process-global clock seam, slot-ledger exactness (per-slot sums
+reconcile with lifetime counters — conservation pinned), first + hits
+== committee sightings (the honest denominator behind
+``key_table_first_sighting_hit_ratio``), 8-thread writer conservation
+under a hammering reader, the bounded-memory retention pin, the
+disabled-path <1µs pin, the ``/lighthouse/slots`` endpoint round-trip
+(no ``cryptography`` on the path), flood stable-committee determinism,
+the ``op_pool_device_agg`` journal kind, and the jax-free subprocess
+pin for the ledger + ``tools/slot_report.py``."""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from lighthouse_tpu.utils import flight_recorder as fr
+from lighthouse_tpu.utils import metrics, slot_clock, slot_ledger
+from lighthouse_tpu.verification_service import traffic
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def ledger():
+    """Enabled ledger with a deterministic manual clock installed on
+    the global seam; everything restored afterwards."""
+    prev = slot_ledger.configure(enabled=True, max_slots=64, max_epochs=64)
+    slot_ledger.reset()
+    prev_clock = slot_clock.set_clock(
+        slot_clock.ManualSlotClock(
+            genesis_time=0, seconds_per_slot=12, slots_per_epoch=32
+        )
+    )
+    try:
+        yield
+    finally:
+        slot_clock.set_clock(prev_clock)
+        slot_ledger.configure(**prev)
+        slot_ledger.reset()
+
+
+@pytest.fixture
+def recorder(tmp_path):
+    prev = fr.configure(
+        capacity=4096, enabled=True, dump=False, dump_dir=str(tmp_path),
+    )
+    fr.clear()
+    try:
+        yield
+    finally:
+        fr.configure(**prev)
+        fr.clear()
+
+
+# ---------------------------------------------------------------------------
+# Slot clock: epoch math + the global seam
+# ---------------------------------------------------------------------------
+
+
+def test_slot_clock_epoch_math_and_global_seam():
+    """Genesis-anchored slot/epoch resolution, the manual test clock,
+    and the settable process-global clock with restore discipline."""
+    c = slot_clock.SlotClock(
+        genesis_time=100.0, seconds_per_slot=12, slots_per_epoch=32
+    )
+    assert c.slot_at(99.0) == 0  # pre-genesis clamps to 0
+    assert c.slot_at(100.0) == 0
+    assert c.slot_at(111.999) == 0
+    assert c.slot_at(112.0) == 1
+    assert c.epoch_of(31) == 0 and c.epoch_of(32) == 1
+    assert c.first_slot_of_epoch(3) == 96
+    assert c.start_of(2) == pytest.approx(124.0)
+    # fractional seconds-per-slot (the replay's scaled clock)
+    f = slot_clock.SlotClock(genesis_time=0.0, seconds_per_slot=0.5)
+    assert f.slot_at(1.74) == 3
+
+    m = slot_clock.ManualSlotClock(
+        genesis_time=100.0, seconds_per_slot=12, slots_per_epoch=32
+    )
+    m.set_slot(65)
+    assert m.now() == 65 and m.current_epoch() == 2
+    assert m.seconds_into_slot() == pytest.approx(0.0)
+    m.advance_seconds(13.0)
+    assert m.now() == 66
+    assert m.seconds_into_slot() == pytest.approx(1.0)
+    assert m.duration_to_next_slot() == pytest.approx(11.0)
+    m.advance_slots(2)
+    assert m.now() == 68
+
+    prev = slot_clock.set_clock(m)
+    try:
+        assert slot_clock.get_clock() is m
+    finally:
+        restored = slot_clock.set_clock(prev)
+        assert restored is m
+    assert slot_clock.get_clock() is not m
+
+
+# ---------------------------------------------------------------------------
+# Producer exactness + lifetime conservation
+# ---------------------------------------------------------------------------
+
+
+def test_producer_exactness_and_lifetime_conservation(ledger):
+    """Every note_* family lands on exactly the right card with exactly
+    the right arithmetic, and sum(retained cards) + evicted == lifetime
+    for every conserved counter."""
+    note = slot_ledger.note_resolution
+    note("aggregate", "fused", 8, 0.010, slot=10)
+    note("aggregate", "fused", 4, 0.050, missed=True, slot=10)
+    note("unaggregated", "bypass", 1, 0.002, slot=10)
+    note("aggregate", "shed", 2, 0.030, slot=11)
+    slot_ledger.note_rejection("block_rejected", slot=10)
+    slot_ledger.note_rejection("block_rejected", slot=10)
+    slot_ledger.note_rejection("sync_rejected", slot=11)
+    slot_ledger.note_h2d_bytes(1000, slot=10)
+    slot_ledger.note_h2d_bytes(24, slot=11)
+    slot_ledger.note_bubble(0.5, slot=10)
+    slot_ledger.note_headroom(0.7, slot=10)
+    slot_ledger.note_headroom(0.3, slot=10)
+    slot_ledger.note_headroom(0.9, slot=11)
+    slot_ledger.note_fresh_compile(stage="msm", slot=11)
+    slot_ledger.note_bulk(admitted_sets=5, parked_sets=3, slot=10)
+    for _ in range(2):
+        slot_ledger.note_committee_sighting("first", slot=10)
+    for _ in range(3):
+        slot_ledger.note_committee_sighting("hit", slot=10)
+    slot_ledger.note_committee_sighting("first", slot=320)  # epoch 10
+    # no explicit slot -> the global clock resolves it
+    clock = slot_clock.get_clock()
+    clock.set_slot(7)
+    note("sync_message", "fused", 6, 0.004)
+
+    cards = {c["slot"]: c for c in slot_ledger.slot_cards()}
+    assert sorted(cards) == [7, 10, 11, 320]
+    c10 = cards[10]
+    assert c10["epoch"] == 0
+    assert c10["sets"] == 13 and c10["verdicts"] == 3 and c10["misses"] == 1
+    assert c10["kinds"]["aggregate"] == {
+        "sets": 12, "verdicts": 2, "misses": 1
+    }
+    assert c10["kinds"]["unaggregated"]["sets"] == 1
+    assert c10["p50_ms"] == pytest.approx(10.0)
+    assert c10["p99_ms"] == pytest.approx(50.0)
+    assert c10["lat_samples"] == 3 and c10["lat_sampled"] == 3
+    assert c10["rejected"] == {"block_rejected": 2}
+    assert c10["rejections"] == 2
+    assert c10["h2d_bytes"] == 1000
+    assert c10["bubble_s"] == pytest.approx(0.5)
+    assert c10["headroom_min"] == pytest.approx(0.3)  # slot MIN, not mean
+    assert c10["headroom_samples"] == 2
+    assert c10["bulk_admitted_sets"] == 5 and c10["bulk_parked_sets"] == 3
+    assert c10["sightings_first"] == 2 and c10["sightings_hit"] == 3
+    c11 = cards[11]
+    assert c11["sets"] == 2 and c11["fresh_compiles"] == 1
+    assert c11["rejected"] == {"sync_rejected": 1}
+    assert cards[7]["sets"] == 6  # clock-resolved attribution
+    assert cards[320]["epoch"] == 10
+
+    # conservation: retained + evicted == lifetime, nothing evicted yet
+    lifetime = slot_ledger.lifetime_totals()
+    evicted = slot_ledger.evicted_totals()
+    for key in lifetime:
+        retained = sum(c[key] for c in cards.values())
+        assert retained + evicted[key] == pytest.approx(lifetime[key]), key
+        assert evicted[key] == 0
+    assert lifetime["sets"] == 21 and lifetime["verdicts"] == 5
+    assert lifetime["sightings_first"] == 3
+    assert lifetime["sightings_hit"] == 3
+
+    # epoch rollup: honest denominator, first + hits == sightings
+    epochs = {e["epoch"]: e for e in slot_ledger.epoch_cards()}
+    assert epochs[0]["first_sightings"] == 2 and epochs[0]["hits"] == 3
+    assert epochs[0]["sightings"] == 5
+    assert epochs[0]["hit_ratio"] == pytest.approx(0.6)
+    assert epochs[10] == {
+        "epoch": 10, "first_sightings": 1, "hits": 0, "sightings": 1,
+        "hit_ratio": 0.0,
+    }
+    ratio = metrics.gauge_vec(
+        "key_table_first_sighting_hit_ratio", labelnames=("epoch",)
+    )
+    assert ratio.with_labels("0").value == pytest.approx(0.6)
+
+    summary = slot_ledger.summary()
+    assert summary["enabled"] is True
+    assert summary["slots_retained"] == 4 and summary["cards_evicted"] == 0
+    assert summary["lifetime"] == lifetime
+    assert summary["latest_epoch"]["epoch"] == 10
+
+
+def test_committee_sighting_model_conservation(ledger):
+    """The jax-free mirror of the key table's admission policy: with
+    ``min_repeats=2``, sightings 1-2 of a tuple are firsts (miss, then
+    miss+insert), 3+ are collapsed hits — and first + hits == sightings
+    both in the model and in the ledger it feeds."""
+    model = slot_ledger.CommitteeSightingModel(min_repeats=2)
+    outcomes = [model.observe((1, 2, 3), slot=4) for _ in range(5)]
+    assert outcomes == ["first", "first", "hit", "hit", "hit"]
+    assert model.first == 2 and model.hits == 3
+    assert model.first + model.hits == 5
+    assert model.hit_ratio() == pytest.approx(0.6)
+    # a different tuple starts its own admission course
+    assert model.observe((7, 8), slot=4) == "first"
+    # min_repeats=1: second consult already collapses
+    eager = slot_ledger.CommitteeSightingModel(min_repeats=1)
+    assert eager.observe((9, 10), slot=4) == "first"
+    assert eager.observe((9, 10), slot=4) == "hit"
+
+    lifetime = slot_ledger.lifetime_totals()
+    assert lifetime["sightings_first"] == 4 and lifetime["sightings_hit"] == 4
+    (card,) = slot_ledger.slot_cards()
+    assert card["sightings_first"] + card["sightings_hit"] == 8
+
+    with pytest.raises(ValueError):
+        slot_ledger.note_committee_sighting("maybe")
+
+
+# ---------------------------------------------------------------------------
+# Threads, retention, disabled cost
+# ---------------------------------------------------------------------------
+
+
+def test_conservation_under_writer_threads(ledger):
+    """8 writer threads, one reader hammering every view: every event
+    lands exactly once (lifetime == writes), cards stay internally
+    consistent mid-flight, conservation holds after the join."""
+    THREADS, N, SLOTS = 8, 500, 32
+    torn = []
+    stop = threading.Event()
+
+    def reader():
+        while not stop.is_set():
+            for card in slot_ledger.slot_cards():
+                # each resolution carries exactly 3 sets; a torn card
+                # would break the invariant
+                if card["sets"] != 3 * card["verdicts"]:
+                    torn.append((card["slot"], card["sets"],
+                                 card["verdicts"]))
+            slot_ledger.summary()
+            slot_ledger.epoch_cards()
+
+    def writer(i):
+        for j in range(N):
+            slot_ledger.note_resolution(
+                f"kind{i}", "fused", 3, 0.001 * (j % 7), slot=j % SLOTS
+            )
+            slot_ledger.note_h2d_bytes(10, slot=j % SLOTS)
+
+    rd = threading.Thread(target=reader, daemon=True)
+    rd.start()
+    ws = [threading.Thread(target=writer, args=(i,)) for i in range(THREADS)]
+    for w in ws:
+        w.start()
+    for w in ws:
+        w.join()
+    stop.set()
+    rd.join(timeout=5)
+    assert not torn, torn[:3]
+
+    lifetime = slot_ledger.lifetime_totals()
+    assert lifetime["verdicts"] == THREADS * N
+    assert lifetime["sets"] == THREADS * N * 3
+    assert lifetime["h2d_bytes"] == THREADS * N * 10
+    cards = slot_ledger.slot_cards()
+    assert len(cards) == SLOTS  # within max_slots: nothing evicted
+    evicted = slot_ledger.evicted_totals()
+    for key in ("sets", "verdicts", "h2d_bytes"):
+        assert sum(c[key] for c in cards) + evicted[key] == lifetime[key]
+
+
+def test_retention_eviction_keeps_conservation(ledger):
+    """The bounded-memory pin: retention evicts oldest-first down to
+    ``max_slots``, evicted cards fold into eviction totals so lifetime
+    conservation survives, and the eviction counter ticks."""
+    evicted0 = metrics.get("slot_ledger_evicted_total").value
+    slot_ledger.configure(max_slots=8)
+    for s in range(40):
+        slot_ledger.note_resolution("aggregate", "fused", 2, 0.001, slot=s)
+    cards = slot_ledger.slot_cards()
+    assert len(cards) == 8
+    assert [c["slot"] for c in cards] == list(range(32, 40))  # newest kept
+    lifetime = slot_ledger.lifetime_totals()
+    evicted = slot_ledger.evicted_totals()
+    assert lifetime["sets"] == 80 and lifetime["verdicts"] == 40
+    assert evicted["sets"] == 64 and evicted["verdicts"] == 32
+    for key in lifetime:
+        assert sum(c[key] for c in cards) + evicted[key] == pytest.approx(
+            lifetime[key]
+        ), key
+    assert metrics.get("slot_ledger_evicted_total").value == evicted0 + 32
+    assert metrics.get("slot_ledger_slots").value == 8
+    summary = slot_ledger.summary()
+    assert summary["slots_retained"] == 8 and summary["cards_evicted"] == 32
+
+    # shrinking applies retention immediately, conservation intact
+    slot_ledger.configure(max_slots=3)
+    cards = slot_ledger.slot_cards()
+    assert [c["slot"] for c in cards] == [37, 38, 39]
+    evicted = slot_ledger.evicted_totals()
+    assert sum(c["sets"] for c in cards) + evicted["sets"] == 80
+
+    # last=N keeps the newest N; last=0 is empty, not an error
+    assert [c["slot"] for c in slot_ledger.slot_cards(last=1)] == [39]
+    assert slot_ledger.slot_cards(last=0) == []
+
+    # epoch rows have their own bound
+    slot_ledger.configure(max_epochs=2)
+    for e in range(5):
+        slot_ledger.note_committee_sighting("first", slot=e * 32)
+    rows = slot_ledger.epoch_cards()
+    assert len(rows) == 2
+    assert [r["epoch"] for r in rows] == [3, 4]
+
+
+def test_disabled_note_costs_under_one_microsecond():
+    """The ISSUE 17 pin: with the ledger disabled, a note_* is one
+    global check — cheap enough to leave in every producer, always."""
+    prev = slot_ledger.configure(enabled=False)
+    try:
+        n = 20_000
+        note = slot_ledger.note_h2d_bytes
+        best = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                note(1)
+            best = min(best, (time.perf_counter() - t0) / n)
+        assert best < 1e-6, (
+            f"disabled note_h2d_bytes costs {best * 1e9:.0f} ns — too "
+            f"expensive for an always-on attribution seam"
+        )
+    finally:
+        slot_ledger.configure(**prev)
+
+
+# ---------------------------------------------------------------------------
+# Journal rejections feed the ledger
+# ---------------------------------------------------------------------------
+
+
+def test_rejected_journal_kinds_land_on_the_slot_card(ledger, recorder):
+    """Every ``*_rejected`` flight-recorder event is chain-time
+    attributed — the journal hook is the single rejection funnel."""
+    slot_clock.get_clock().set_slot(5)
+    fr.record("block_rejected", reason="zgate_bad_signature")
+    fr.record("attestation_rejected", reason="zgate_unknown_head")
+    fr.record("slo_burn", window="fast")  # non-rejection: not attributed
+    (card,) = slot_ledger.slot_cards()
+    assert card["slot"] == 5
+    assert card["rejected"] == {
+        "attestation_rejected": 1, "block_rejected": 1
+    }
+    assert slot_ledger.lifetime_totals()["rejections"] == 2
+
+
+# ---------------------------------------------------------------------------
+# /lighthouse/slots endpoint (no `cryptography` on the path)
+# ---------------------------------------------------------------------------
+
+
+def test_slots_endpoint_round_trip_and_health_chain_time(ledger):
+    """/lighthouse/slots round-trips both views with the documented
+    grammar (400 on bad view/last), and /lighthouse/health carries the
+    chain_time block — no ``cryptography`` dependency anywhere."""
+    import copy
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    from lighthouse_tpu.beacon_chain import BeaconChain
+    from lighthouse_tpu.http_api import BeaconApiServer
+    from lighthouse_tpu.state_transition import store_replayer
+    from lighthouse_tpu.store import HotColdDB, MemoryStore
+    from lighthouse_tpu.testing.harness import StateHarness
+    from lighthouse_tpu.types.chain_spec import minimal_spec
+    from lighthouse_tpu.types.preset import MINIMAL
+    from lighthouse_tpu.utils.slot_clock import ManualSlotClock
+
+    slot_ledger.note_resolution("aggregate", "fused", 8, 0.010, slot=3)
+    slot_ledger.note_resolution(
+        "aggregate", "fused", 4, 0.060, missed=True, slot=4
+    )
+    slot_ledger.note_committee_sighting("first", slot=3)
+    slot_ledger.note_committee_sighting("hit", slot=4)
+
+    h = StateHarness(
+        MINIMAL, minimal_spec(), validator_count=8, fork_name="phase0",
+        fake_sign=True,
+    )
+    genesis = copy.deepcopy(h.state)
+    db = HotColdDB(
+        MemoryStore(), h.t, h.spec, store_replayer(h.preset, h.spec)
+    )
+    clock = ManualSlotClock(genesis.genesis_time, h.spec.seconds_per_slot)
+    chain = BeaconChain(h.preset, h.spec, h.t, db, genesis, slot_clock=clock)
+    server = BeaconApiServer(chain, port=0).start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(
+            base + "/lighthouse/slots", timeout=5
+        ) as r:
+            doc = _json.load(r)["data"]
+        assert doc["schema"] == slot_ledger.SCHEMA
+        assert doc["view"] == "slots"
+        assert [row["slot"] for row in doc["rows"]] == [3, 4]
+        assert doc["rows"][0]["sets"] == 8
+        assert doc["rows"][1]["misses"] == 1
+        assert doc["lifetime"]["sets"] == 12
+        assert doc["chain_time"]["enabled"] is True
+        # conservation is checkable straight off the wire
+        retained = sum(row["sets"] for row in doc["rows"])
+        assert retained + doc["evicted"]["sets"] == doc["lifetime"]["sets"]
+
+        with urllib.request.urlopen(
+            base + "/lighthouse/slots?view=epochs", timeout=5
+        ) as r:
+            doc = _json.load(r)["data"]
+        assert doc["view"] == "epochs"
+        (row,) = doc["rows"]
+        assert row["first_sightings"] + row["hits"] == row["sightings"] == 2
+        assert row["hit_ratio"] == pytest.approx(0.5)
+
+        with urllib.request.urlopen(
+            base + "/lighthouse/slots?last=1", timeout=5
+        ) as r:
+            doc = _json.load(r)["data"]
+        assert [row["slot"] for row in doc["rows"]] == [4]
+
+        for bad in ("view=minutes", "last=abc", "last=-1"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    base + "/lighthouse/slots?" + bad, timeout=5
+                )
+            assert ei.value.code == 400, bad
+
+        with urllib.request.urlopen(
+            base + "/lighthouse/health", timeout=5
+        ) as r:
+            health = _json.load(r)["data"]
+        ct = health["chain_time"]
+        assert ct["enabled"] is True
+        assert ct["lifetime"]["sets"] == 12
+        assert ct["latest_epoch"]["first_sightings"] == 1
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Flood realism: stable committees, deterministically
+# ---------------------------------------------------------------------------
+
+
+def test_flood_stable_committees_deterministic():
+    """The epoch's committee shuffle is FIXED: flood aggregates draw
+    their ``validators`` tuple from the same ``n_committees`` disjoint
+    tuples on every run of a seed — the recurrence the aggregate-cache
+    collapse keys on."""
+    kw = dict(duration_s=12.0, seed=9, committee=8, n_committees=16)
+    evs1 = traffic.epoch_boundary_flood(**kw)
+    evs2 = traffic.epoch_boundary_flood(**kw)
+    assert evs1 == evs2  # full-trace determinism, validators included
+    expected = {
+        tuple(range(c * 8, (c + 1) * 8)) for c in range(16)
+    }
+    seen = [tuple(e["validators"]) for e in evs1 if "validators" in e]
+    assert seen, "flood trace carries no committee identities"
+    assert set(seen) <= expected
+    assert len(set(seen)) > 1  # more than one committee recurs
+    # recurrence is the point: strictly fewer tuples than sightings
+    assert len(set(seen)) < len(seen)
+    for i, ev in enumerate(evs1):
+        traffic._validate_event(ev, i + 2)
+
+
+def test_lockstep_flood_slots_visible_and_sighting_conservation():
+    """The acceptance shape, jax-free: on an epoch_boundary_flood
+    lockstep replay the flood slots are individually visible (demand
+    > 2x the median slot) and first + hits == sightings."""
+    evs = traffic.epoch_boundary_flood(duration_s=12.0, seed=7)
+    doc = traffic.lockstep_replay(evs, slot_s=2.0, slots_per_epoch=32)
+    rows = doc["slots"]
+    assert rows and doc["chain_time"]["n_slots"] == len(rows)
+    per_slot = sorted(r["sets"] for r in rows)
+    median = per_slot[len(per_slot) // 2]
+    flood = [r for r in rows if r["sets"] > 2 * median]
+    assert flood, "flood slots not visible above the quiet median"
+    ct = doc["chain_time"]
+    assert ct["first_sightings"] + ct["sighting_hits"] == (
+        ct["committee_sightings"]
+    )
+    assert ct["committee_sightings"] > 0
+    assert ct["first_sighting_hit_ratio"] == pytest.approx(
+        ct["sighting_hits"] / ct["committee_sightings"], abs=1e-4
+    )
+    # per-slot rollup reconciles with the chain_time totals
+    assert sum(r["sightings_first"] for r in rows) == ct["first_sightings"]
+    assert sum(r["sightings_hit"] for r in rows) == ct["sighting_hits"]
+
+
+# ---------------------------------------------------------------------------
+# op_pool_device_agg journal (ISSUE 16 surface wired in ISSUE 17)
+# ---------------------------------------------------------------------------
+
+
+def test_device_agg_journals_ok_and_fallback(recorder, monkeypatch):
+    """Every device G2-sum merge journals an ``op_pool_device_agg``
+    event — outcome, batch size, pad rung, wall time, and the error on
+    the fallback path."""
+    from lighthouse_tpu.compile_service.service import MSM_RUNGS
+    from lighthouse_tpu.crypto.device import bls as dbls
+    from lighthouse_tpu.operation_pool import DeviceAggregator
+
+    assert "op_pool_device_agg" in fr.EVENT_KINDS
+
+    class _FakeSig:
+        def point_or_infinity(self):
+            return object()
+
+    class _FakeInfinity:
+        def is_infinity(self):
+            return True
+
+    agg = DeviceAggregator(min_batch=2)
+    pad = min(r for r in sorted(MSM_RUNGS) if r >= 3)
+
+    monkeypatch.setattr(
+        dbls, "device_sum_g2", lambda pts, pad_n=None: _FakeInfinity()
+    )
+    out = agg.aggregate([_FakeSig() for _ in range(3)])
+    assert out is not None
+    (ev,) = fr.events(kinds=["op_pool_device_agg"])
+    assert ev["fields"]["outcome"] == "ok"
+    assert ev["fields"]["n_points"] == 3
+    assert ev["fields"]["pad_n"] == pad
+    assert ev["fields"]["wall_s"] >= 0
+
+    def boom(pts, pad_n=None):
+        raise RuntimeError("zgate device down")
+
+    monkeypatch.setattr(dbls, "device_sum_g2", boom)
+    assert agg.aggregate([_FakeSig() for _ in range(3)]) is None
+    evs = fr.events(kinds=["op_pool_device_agg"])
+    assert len(evs) == 2
+    assert evs[-1]["fields"]["outcome"] == "fallback"
+    assert "zgate device down" in evs[-1]["fields"]["error"]
+
+
+# ---------------------------------------------------------------------------
+# jax-freedom, subprocess-pinned
+# ---------------------------------------------------------------------------
+
+
+def test_slot_ledger_and_slot_report_jax_free_subprocess():
+    """The hard repo rule: utils/slot_ledger.py, utils/slot_clock.py
+    and tools/slot_report.py import and run (ledger round-trip, sighting
+    model, lockstep scoreboard) without pulling jax."""
+    code = (
+        "import sys\n"
+        "from lighthouse_tpu.utils import slot_clock, slot_ledger\n"
+        "slot_ledger.configure(enabled=True)\n"
+        "slot_ledger.reset()\n"
+        "slot_clock.set_clock(slot_clock.ManualSlotClock(0, 2.0))\n"
+        "slot_ledger.note_resolution('aggregate', 'fused', 4, 0.01, slot=3)\n"
+        "m = slot_ledger.CommitteeSightingModel()\n"
+        "outcomes = [m.observe((1, 2, 3), slot=3) for _ in range(5)]\n"
+        "assert m.first + m.hits == 5\n"
+        "cards = slot_ledger.slot_cards()\n"
+        "assert cards and cards[0]['sets'] == 4\n"
+        "assert slot_ledger.summary()['lifetime']['sets'] == 4\n"
+        "import tools.slot_report as sr\n"
+        "rep = {'schema': sr.REPORT_SCHEMA, **sr.normalize(\n"
+        "    {'view': 'slots', 'rows': cards,\n"
+        "     'chain_time': slot_ledger.summary()})}\n"
+        "assert sr.render(rep)\n"
+        "from lighthouse_tpu.verification_service import traffic\n"
+        "evs = traffic.epoch_boundary_flood(duration_s=6.0, seed=1)\n"
+        "doc = traffic.lockstep_replay(evs, slot_s=2.0)\n"
+        "rep2 = sr.normalize(doc)\n"
+        "assert rep2['source'] == 'lockstep' and rep2['slots']\n"
+        "for e in rep2['epochs']:\n"
+        "    assert e['first_sightings'] + e['hits'] == e['sightings']\n"
+        "assert 'jax' not in sys.modules, 'slot ledger must stay jax-free'\n"
+    )
+    r = subprocess.run(
+        [sys.executable, "-c", code],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr

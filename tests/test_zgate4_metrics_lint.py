@@ -39,11 +39,13 @@ KNOWN_PREFIXES = (
     "flight_recorder_",
     "head_",
     "http_api_",
+    "key_table_",  # epoch first-sighting dial (utils/slot_ledger.py, ISSUE 17)
     "log_",
     "monitoring_",
     "network_",
     "op_pool_",
     "slasher_",
+    "slot_",  # chain-time slot ledger (utils/slot_ledger.py, ISSUE 17)
     "store_",
     "sync_",
     "testm_",  # test-only families from tests/test_metrics_depth.py
@@ -72,6 +74,7 @@ def _import_instrumented_modules():
     import lighthouse_tpu.utils.flight_recorder  # noqa: F401
     import lighthouse_tpu.utils.logging  # noqa: F401
     import lighthouse_tpu.utils.monitoring  # noqa: F401
+    import lighthouse_tpu.utils.slot_ledger  # noqa: F401
     import lighthouse_tpu.utils.timeseries  # noqa: F401
     import lighthouse_tpu.verification_service.batcher  # noqa: F401
 
@@ -492,7 +495,7 @@ def test_capacity_timeseries_and_burn_families_registered():
     ).read()
     for spec in timeseries.SAMPLE_FAMILIES:
         assert _NAME.match(spec.family), spec.family
-        assert spec.family.startswith("capacity_"), spec.family
+        assert spec.family.startswith(("capacity_", "slot_")), spec.family
         assert f"`{spec.family}`" in docs, (
             f"sampler family {spec.family!r} missing from "
             f"docs/OBSERVABILITY.md — the allowlist must stay documented"
@@ -558,6 +561,46 @@ def test_bulk_qos_families_registered():
     assert DEFAULT_RUNGS[-2:] == ((512, 1, 512), (256, 1, 256))
     for b, k, m in DEFAULT_RUNGS[-2:]:
         assert m >= b, "a bulk rung must cover per-set-distinct messages"
+
+
+def test_slot_ledger_families_registered():
+    """ISSUE 17 families (utils/slot_ledger.py) exist under their
+    declared types + labels, the event catalogue stays a sorted
+    registry, the schema is versioned like the trace schema, and the
+    report tool imports cleanly (jax-freedom is subprocess-pinned in
+    tests/test_slot_ledger.py)."""
+    _import_instrumented_modules()
+    reg = metrics.registry_snapshot()
+    want = {
+        "slot_ledger_slots": ("gauge", None),
+        "slot_ledger_evicted_total": ("counter", None),
+        "slot_ledger_events_total": ("counter", ("event",)),
+        "key_table_first_sighting_hit_ratio": ("gauge", ("epoch",)),
+    }
+    for name, (kind, labels) in want.items():
+        m = reg.get(name)
+        assert m is not None, f"family {name} not registered"
+        assert m.kind == kind, (name, m.kind)
+        if labels is not None:
+            assert m.labelnames == labels, (name, m.labelnames)
+        else:
+            assert not hasattr(m, "labelnames"), name  # unlabeled family
+    from lighthouse_tpu.utils import slot_ledger
+
+    # the event catalogue reads as a registry: sorted, unique, snake
+    evs = slot_ledger.EVENTS
+    assert evs and list(evs) == sorted(evs) and len(set(evs)) == len(evs)
+    for ev in evs:
+        assert _NAME.match(ev), f"slot event not snake_case: {ev!r}"
+    assert re.fullmatch(
+        r"lighthouse_tpu\.slot_ledger/\d+", slot_ledger.SCHEMA
+    ), slot_ledger.SCHEMA
+    # note_committee_sighting refuses outcomes outside the pair — the
+    # conservation invariant (first + hits == sightings) depends on it
+    if slot_ledger.enabled():
+        with pytest.raises(ValueError):
+            slot_ledger.note_committee_sighting("zgate4_undeclared")
+    import tools.slot_report  # noqa: F401
 
 
 def test_warmup_tool_imports_and_dry_run_lists_ladder(capsys, monkeypatch):

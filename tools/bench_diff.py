@@ -134,6 +134,17 @@ METRICS = (
     ("kernel_msm_g1_point_adds_per_sec",
      ("kernels", "msm_g1", "impls", "windowed_g1", "point_adds_per_sec"),
      True),
+    # ISSUE 17: the slot-aligned epoch-flood leg — chain-time
+    # attribution on the canonical flood trace. LEARNED, not gated
+    # (None direction): the per-slot p99 spread tracks WHERE the tail
+    # lives, and the first-sighting hit ratio tracks the committee
+    # cache dial — both stub-backend wall-clock instruments, not SLOs
+    ("epoch_flood_p99_spread_ms",
+     ("epoch_flood_leg", "p99_spread_ms"), None),
+    ("epoch_flood_quiet_p99_ms",
+     ("epoch_flood_leg", "quiet_p99_ms"), None),
+    ("epoch_flood_first_sighting_ratio",
+     ("epoch_flood_leg", "first_sighting_hit_ratio"), None),
 )
 
 # the metrics whose regression exits nonzero (ISSUE 8 throughput/waste

@@ -430,6 +430,24 @@ def main() -> None:
       "`replay_leg` in the bench JSON "
       "([TRAFFIC_REPLAY.md](TRAFFIC_REPLAY.md); families in "
       "[OBSERVABILITY.md](OBSERVABILITY.md)).")
+    w("- Epoch-boundary cost, in chain time (ISSUE 17): the arrival "
+      "process is not stationary — the attestation flood concentrates "
+      "~8x demand into the two epoch-boundary slots, so a lifetime "
+      "mean under-prices exactly the window where the per-batch costs "
+      "above bind hardest (deep queues push flushes to the large-B "
+      "rungs; cold shapes and parked bulk land there too). The slot "
+      "ledger attributes every resolution, miss, byte and bubble to "
+      "its beacon slot (`utils/slot_ledger.py`, per-slot report cards "
+      "at `/lighthouse/slots`), and the SAME window is where the "
+      "epoch-stable committee tuples make the key table's cached "
+      "aggregate-sum slot (K=1 above) pay: the per-epoch "
+      "`key_table_first_sighting_hit_ratio` dial says how much of the "
+      "flood's K G1-add aggregation cost actually collapsed — the "
+      "canonical flood replays near 0.8, i.e. ~4/5 of committee "
+      "sightings skip the host EC sum entirely (the bench "
+      "`epoch_flood_leg` tracks the per-slot p99 spread and the dial; "
+      "[OBSERVABILITY.md](OBSERVABILITY.md) chain-time section; "
+      "[TRAFFIC_REPLAY.md](TRAFFIC_REPLAY.md)).")
     w("- Per-chip scaling (ISSUE 11): every table above prices ONE "
       "chip, and the dp mesh multiplies it — flush plans gain a "
       "(dp_shard × rung) axis, each shard's kind-homogeneous sub-batch "

@@ -1,0 +1,260 @@
+"""Render chain-time observability (ISSUE 17) — a per-slot scoreboard
+from a live node's slot ledger, a saved traffic_replay report, or a
+fresh jax-free lockstep replay of a synthetic trace.
+
+The same live/model split as ``tools/capacity_report.py``:
+
+    # live node: retained slot report cards (/lighthouse/slots) plus
+    # the epoch first-sighting view and the health chain_time block
+    python tools/slot_report.py --url http://127.0.0.1:5052
+    python tools/slot_report.py --url ... --view epochs --last 8
+
+    # saved report: re-render the slot-aligned section of a
+    # tools/traffic_replay.py report (timed or lockstep mode)
+    python tools/slot_report.py --replay /tmp/flood_report.json
+
+    # jax-free model: lockstep-replay a generated trace and score its
+    # slots (the canonical epoch-boundary demo)
+    python tools/slot_report.py --generate epoch_boundary_flood \\
+        --duration 12 --json
+
+The scoreboard answers the triage question "WHEN did it hurt": each
+retained slot is one row — sets resolved, deadline misses, in-slot
+p99, H2D bytes, bubble seconds, bulk admitted, committee first
+sightings vs collapsed hits, minimum headroom — so an epoch-boundary
+flood reads as two hot rows instead of a smeared lifetime average.
+The epoch view rolls the committee sightings up into
+``key_table_first_sighting_hit_ratio`` per epoch (ROADMAP item 3's
+go/no-go dial); conservation (first + hits == sightings) is checkable
+from the same rows.
+
+Jax-free (subprocess-pinned by tests/test_slot_ledger.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPORT_SCHEMA = "lighthouse_tpu.slot_report/1"
+
+
+def fetch_json(url: str) -> dict:
+    from urllib.request import urlopen
+
+    with urlopen(url, timeout=10) as r:
+        return json.load(r)["data"]
+
+
+# ---------------------------------------------------------------------------
+# Row normalization: ledger cards (live / timed reports) and lockstep
+# slot rows carry different keys; the scoreboard renders one shape.
+# ---------------------------------------------------------------------------
+
+
+def _norm_ledger_card(card: dict) -> dict:
+    return {
+        "slot": card["slot"],
+        "epoch": card["epoch"],
+        "sets": card["sets"],
+        "misses": card["misses"],
+        "p99_ms": card.get("p99_ms"),
+        "h2d_bytes": card.get("h2d_bytes", 0),
+        "bubble_s": card.get("bubble_s", 0.0),
+        "bulk_sets": card.get("bulk_admitted_sets", 0),
+        "first": card.get("sightings_first", 0),
+        "hits": card.get("sightings_hit", 0),
+        "headroom_min": card.get("headroom_min"),
+    }
+
+
+def _norm_lockstep_row(row: dict) -> dict:
+    return {
+        "slot": row["slot"],
+        "epoch": row["epoch"],
+        "sets": row["sets"],
+        "misses": None,  # lockstep has no wall clock, hence no misses
+        "p99_ms": None,
+        "h2d_bytes": 0,
+        "bubble_s": 0.0,
+        "bulk_sets": row.get("bulk_sets", 0),
+        "first": row.get("sightings_first", 0),
+        "hits": row.get("sightings_hit", 0),
+        "headroom_min": None,
+    }
+
+
+def normalize(doc: dict) -> dict:
+    """A traffic_replay report (timed or lockstep), or a
+    ``/lighthouse/slots`` document, → the scoreboard shape."""
+    if "rows" in doc and "view" in doc:  # /lighthouse/slots document
+        rows = doc["rows"]
+        if doc["view"] == "epochs":
+            return {
+                "source": "live",
+                "chain_time": doc.get("chain_time"),
+                "slots": [],
+                "epochs": rows,
+            }
+        return {
+            "source": "live",
+            "chain_time": doc.get("chain_time"),
+            "slots": [_norm_ledger_card(c) for c in rows],
+            "epochs": [],
+        }
+    mode = doc.get("mode")
+    if mode == "lockstep":
+        ct = doc.get("chain_time") or {}
+        epochs = {}
+        for row in doc.get("slots", []):
+            e = epochs.setdefault(
+                row["epoch"], {"epoch": row["epoch"], "first_sightings": 0,
+                               "hits": 0},
+            )
+            e["first_sightings"] += row.get("sightings_first", 0)
+            e["hits"] += row.get("sightings_hit", 0)
+        for e in epochs.values():
+            tot = e["first_sightings"] + e["hits"]
+            e["sightings"] = tot
+            e["hit_ratio"] = round(e["hits"] / tot, 6) if tot else None
+        return {
+            "source": "lockstep",
+            "chain_time": ct,
+            "slots": [_norm_lockstep_row(r) for r in doc.get("slots", [])],
+            "epochs": [epochs[k] for k in sorted(epochs)],
+        }
+    if mode == "timed":
+        return {
+            "source": "timed",
+            "chain_time": doc.get("chain_time"),
+            "slots": [_norm_ledger_card(c) for c in doc.get("slots", [])],
+            "epochs": doc.get("epochs", []),
+        }
+    raise SystemExit(
+        "unrecognized document: want a traffic_replay report "
+        "(mode timed|lockstep) or a /lighthouse/slots reply"
+    )
+
+
+# ---------------------------------------------------------------------------
+# Rendering
+# ---------------------------------------------------------------------------
+
+
+def render(rep: dict) -> str:
+    ct = rep.get("chain_time") or {}
+    head = f"slot scoreboard ({rep['source']})"
+    sightings = ct.get("committee_sightings")
+    if sightings is None:
+        lt = ct.get("lifetime") or {}
+        first = lt.get("sightings_first", 0)
+        hits = lt.get("sightings_hit", 0)
+        sightings = first + hits
+    else:
+        first = ct.get("first_sightings", 0)
+        hits = ct.get("sighting_hits", 0)
+    if sightings:
+        head += (
+            f": first-sighting hit ratio {round(hits / sightings, 4)} "
+            f"({hits} hits / {first} first / {sightings} sightings)"
+        )
+    lines = [head]
+    if rep["slots"]:
+        # absolute mainnet slot numbers are 9+ digits — size the chain-
+        # time columns to the widest row instead of a fixed 6
+        sw = max(6, *(len(str(r["slot"])) + 1 for r in rep["slots"]))
+        ew = max(6, *(len(str(r["epoch"])) + 1 for r in rep["slots"]))
+        lines.append(
+            f"  {'slot':>{sw}}{'epoch':>{ew}}{'sets':>7}{'miss':>6}"
+            f"{'p99_ms':>9}{'h2d_B':>10}{'bubble_s':>9}{'bulk':>6}"
+            f"{'first':>6}{'hits':>6}{'hdroom':>8}"
+        )
+        for r in rep["slots"]:
+            dash = lambda v, fmt="{}": "-" if v is None else fmt.format(v)
+            lines.append(
+                f"  {r['slot']:>{sw}}{r['epoch']:>{ew}}{r['sets']:>7}"
+                f"{dash(r['misses']):>6}{dash(r['p99_ms']):>9}"
+                f"{r['h2d_bytes']:>10}{round(r['bubble_s'], 3):>9}"
+                f"{r['bulk_sets']:>6}{r['first']:>6}{r['hits']:>6}"
+                f"{dash(r['headroom_min']):>8}"
+            )
+    if rep["epochs"]:
+        ew = max(6, *(len(str(e["epoch"])) + 1 for e in rep["epochs"]))
+        lines.append(
+            f"  {'epoch':>{ew}}{'first':>7}{'hits':>7}{'sightings':>11}"
+            f"{'hit_ratio':>11}"
+        )
+        for e in rep["epochs"]:
+            ratio = e.get("hit_ratio")
+            lines.append(
+                f"  {e['epoch']:>{ew}}{e['first_sightings']:>7}"
+                f"{e['hits']:>7}{e.get('sightings', 0):>11}"
+                f"{'-' if ratio is None else ratio:>11}"
+            )
+    if not rep["slots"] and not rep["epochs"]:
+        lines.append("  (no slot activity recorded)")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--url", help="live node base URL")
+    src.add_argument("--replay", help="saved tools/traffic_replay.py report")
+    src.add_argument("--generate", metavar="GENERATOR",
+                     help="synthesize + lockstep-replay a trace (jax-free)")
+    ap.add_argument("--view", choices=("slots", "epochs"), default="slots",
+                    help="live mode: which ledger view to fetch")
+    ap.add_argument("--last", type=int, default=None,
+                    help="live mode: only the N newest rows")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--duration", type=float, default=12.0)
+    ap.add_argument("--rate-scale", type=float, default=1.0)
+    ap.add_argument("--slot-s", type=float, default=2.0)
+    ap.add_argument("--slots-per-epoch", type=int, default=32)
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.url:
+        base = args.url.rstrip("/")
+        q = [f"view={args.view}"]
+        if args.last is not None:
+            q.append(f"last={args.last}")
+        doc = fetch_json(base + "/lighthouse/slots?" + "&".join(q))
+    elif args.replay:
+        with open(args.replay) as f:
+            doc = json.load(f)
+    else:
+        from lighthouse_tpu.verification_service import traffic
+
+        gen = traffic.GENERATORS.get(args.generate)
+        if gen is None:
+            raise SystemExit(
+                f"unknown generator {args.generate!r} "
+                f"(have: {', '.join(sorted(traffic.GENERATORS))})"
+            )
+        events = sorted(
+            gen(duration_s=args.duration, seed=args.seed,
+                rate_scale=args.rate_scale),
+            key=lambda e: e["t"],
+        )
+        doc = traffic.lockstep_replay(
+            events, slot_s=args.slot_s,
+            slots_per_epoch=args.slots_per_epoch,
+        )
+    rep = {"schema": REPORT_SCHEMA, **normalize(doc)}
+    print(json.dumps(rep) if args.json else render(rep))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -332,6 +332,8 @@ def run_timed_replay(
     max_workers: int = 64,
     result_timeout_s: float = 120.0,
     plan_flushes: bool | None = None,
+    slot_s: float = 2.0,
+    slots_per_epoch: int = 32,
 ) -> dict:
     """Drive a live ``VerificationScheduler`` with the trace's arrival
     process: payloads are pre-built (host set construction must not skew
@@ -347,10 +349,20 @@ def run_timed_replay(
     ``dispatch_lag_ms`` says how faithful the replayed arrival process
     was; a p99 lag comparable to the deadline means the pool, not the
     scheduler, shaped the tail — raise ``max_workers`` or
-    ``time_scale``."""
+    ``time_scale``.
+
+    Chain-time (ISSUE 17): a replay-scoped slot clock is installed so
+    the batcher's attribution lands on the TRACE's slots (genesis = the
+    replay's t=0, one slot every ``slot_s * time_scale`` wall seconds),
+    the slot ledger is reset for the run, and the report carries the
+    per-slot report cards plus the epoch first-sighting view. Events
+    carrying a ``validators`` tuple feed a jax-free committee-sighting
+    model mirroring the key table's admission policy (stub and
+    cpu-native backends have no device key table to consult — the dial
+    must still be measurable on those replays)."""
     from concurrent.futures import ThreadPoolExecutor
 
-    from lighthouse_tpu.utils import metrics
+    from lighthouse_tpu.utils import metrics, slot_clock, slot_ledger
     from lighthouse_tpu.verification_service import VerificationScheduler
 
     events = sorted(events, key=lambda e: e["t"])
@@ -381,10 +393,17 @@ def run_timed_replay(
     outcomes = {"ok": 0, "invalid": 0, "error": 0}
     lags = []  # seconds each dispatch started behind its intended arrival
     olock = threading.Lock()
+    sightings = slot_ledger.CommitteeSightingModel()
 
     def dispatch(ev, sets, due):
         with olock:
             lags.append(max(0.0, time.monotonic() - due))
+            vals = ev.get("validators")
+            if vals and len(vals) > 1:
+                # fed at ARRIVAL (under olock: the admission order must
+                # be deterministic per trace) so the sighting lands on
+                # the event's own slot
+                sightings.observe(vals)
         try:
             if ev["path"] == "verify_now":
                 ok = sched.verify_now(sets, ev["kind"])
@@ -410,6 +429,18 @@ def run_timed_replay(
     pool = ThreadPoolExecutor(
         max_workers=max_workers, thread_name_prefix="replay"
     )
+    # replay-scoped chain time: genesis anchors at the replay's t=0 and
+    # one trace slot lasts slot_s * time_scale wall seconds, so every
+    # producer's slot attribution maps back to the TRACE's slots
+    prev_clock = slot_clock.set_clock(
+        slot_clock.SlotClock(
+            genesis_time=time.time(),
+            seconds_per_slot=max(1e-6, slot_s * time_scale),
+            slots_per_epoch=slots_per_epoch,
+        )
+    )
+    prev_ledger = slot_ledger.configure(enabled=True)
+    slot_ledger.reset()
     t_start = time.monotonic()
     try:
         futures = []
@@ -431,6 +462,13 @@ def run_timed_replay(
                 from lighthouse_tpu import compile_service as cs_mod
 
                 cs_mod.clear_service(svc)
+        # harvest chain-time BEFORE restoring the process clock so the
+        # summary's current_slot still reads in trace coordinates
+        chain_time = slot_ledger.summary()
+        slot_rows = slot_ledger.slot_cards()
+        epoch_rows = slot_ledger.epoch_cards()
+        slot_clock.set_clock(prev_clock)
+        slot_ledger.configure(**prev_ledger)
 
     # per-(kind|path) observation deltas from the cumulative family —
     # the replay's own contribution, even in a long-lived process
@@ -457,6 +495,8 @@ def run_timed_replay(
             "time_scale": time_scale,
             "max_workers": max_workers,
             "compile_service": svc is not None,
+            "slot_s": slot_s,
+            "slots_per_epoch": slots_per_epoch,
         },
         "n_events": len(events),
         "n_sets": sum(ev["n_sets"] for ev in events),
@@ -487,6 +527,18 @@ def run_timed_replay(
         "verdict_latency_samples": samples,
         "scheduler": sched.status(),
         "compile_service": None if svc is None else svc.status(),
+        # chain-time view: per-slot report cards harvested from the
+        # slot ledger under the replay-scoped clock, plus the committee
+        # first-sighting model fed at dispatch admission
+        "chain_time": dict(
+            chain_time,
+            committee_sightings=sightings.first + sightings.hits,
+            first_sightings=sightings.first,
+            sighting_hits=sightings.hits,
+            first_sighting_hit_ratio=sightings.hit_ratio(),
+        ),
+        "slots": slot_rows,
+        "epochs": epoch_rows,
     }
 
 
@@ -539,6 +591,23 @@ def _print_human(header, report):
             )
         if len(report["flushes"]) > 12:
             print(f"  … {len(report['flushes']) - 12} more flushes")
+        ct = report.get("chain_time")
+        if ct:
+            print(
+                f"  chain time: {ct['n_slots']} slots @ {ct['slot_s']}s, "
+                f"first-sighting hit ratio "
+                f"{ct['first_sighting_hit_ratio']} "
+                f"({ct['sighting_hits']}/{ct['committee_sightings']})"
+            )
+            print(f"  {'slot':>6}{'epoch':>6}{'arrivals':>9}{'sets':>6}"
+                  f"{'flushed':>8}{'bulk':>6}{'first':>6}{'hits':>6}")
+            for row in report.get("slots", []):
+                print(
+                    f"  {row['slot']:>6}{row['epoch']:>6}"
+                    f"{row['arrivals']:>9}{row['sets']:>6}"
+                    f"{row['flushed_sets']:>8}{row['bulk_sets']:>6}"
+                    f"{row['sightings_first']:>6}{row['sightings_hit']:>6}"
+                )
         return
     slo = report["slo"]
     print(
@@ -579,6 +648,23 @@ def _print_human(header, report):
             f"{rec['p99_ms']:>9}{rec['window_miss_ratio'] * 100:>6.1f}%"
             f"  {paths}"
         )
+    ct = report.get("chain_time")
+    if ct and report.get("slots"):
+        print(
+            f"  chain time: {len(report['slots'])} slot cards, "
+            f"first-sighting hit ratio {ct['first_sighting_hit_ratio']} "
+            f"({ct['sighting_hits']}/{ct['committee_sightings']})"
+        )
+        print(f"  {'slot':>6}{'epoch':>6}{'sets':>7}{'misses':>7}"
+              f"{'p99_ms':>9}{'h2d_B':>10}{'bulk':>6}{'hdroom':>8}")
+        for row in report["slots"]:
+            hd = row.get("headroom_min")
+            print(
+                f"  {row['slot']:>6}{row['epoch']:>6}{row['sets']:>7}"
+                f"{row['misses']:>7}{row['p99_ms']:>9}"
+                f"{row['h2d_bytes']:>10}{row['bulk_admitted_sets']:>6}"
+                f"{'-' if hd is None else hd:>8}"
+            )
 
 
 def main(argv=None) -> int:
@@ -678,6 +764,15 @@ def main(argv=None) -> int:
         help="pin the legacy single-rung flush (every device flush "
         "resolves on the `fused` path)",
     )
+    run.add_argument(
+        "--slot-s", type=float, default=2.0,
+        help="trace seconds per chain slot for slot-aligned attribution "
+        "(both modes; the canonical generators emit 2 s slots)",
+    )
+    run.add_argument(
+        "--slots-per-epoch", type=int, default=32,
+        help="slots per epoch for the epoch first-sighting view",
+    )
     out = ap.add_argument_group("output")
     out.add_argument("--json", action="store_true",
                      help="print one JSON report line")
@@ -717,6 +812,7 @@ def main(argv=None) -> int:
             events, deadline_ms=args.deadline_ms,
             max_batch_sets=args.max_batch,
             shards=list(range(args.dp)) if args.dp > 1 else None,
+            slot_s=args.slot_s, slots_per_epoch=args.slots_per_epoch,
         )
         report["trace"] = {
             k: header.get(k) for k in ("name", "seed", "n_events")
@@ -812,6 +908,8 @@ def main(argv=None) -> int:
                 compile_service=svc,
                 max_workers=args.workers,
                 plan_flushes=False if args.no_planner else None,
+                slot_s=args.slot_s,
+                slots_per_epoch=args.slots_per_epoch,
             )
         finally:
             if dmesh is not None:
