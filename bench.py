@@ -1084,6 +1084,90 @@ def measure_bulk_leg(
     }
 
 
+def measure_watchtower_leg(
+    use_cpu: bool,
+    seed: int = 7,
+    duration_s: float = 14.0,
+    rate_scale: float = 2.2,
+    deadline_ms: float = 250.0,
+) -> dict:
+    """Anomaly-watchtower economics (ISSUE 18): the acceptance
+    ``saturation_ramp`` replay twice — watchtower OFF then ON (isolated
+    sampler + evaluator armed around the replay) — recording (a) the
+    evaluator's throughput overhead as the sets/s delta, flagged
+    against the documented <1% budget, and (b) the DETECTION LEAD on
+    the ON run: how many seconds the ``headroom_floor`` page opened
+    before the first deadline-miss burst. Both ride the trajectory
+    LEARNED (stub-backend wall-clock instruments, not SLOs); the hard
+    acceptance lives in ``tests/test_watchtower.py``. A negative lead
+    here means the watchtower has become a postmortem tool — exactly
+    what the predictive headroom dial (COST_MODEL.md) exists to
+    prevent."""
+    replay = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools",
+        "traffic_replay.py",
+    )
+    env = dict(os.environ)
+    if use_cpu:
+        env["JAX_PLATFORMS"] = "cpu"
+    base_args = [
+        sys.executable, replay,
+        "--generate", "saturation_ramp", "--seed", str(seed),
+        "--duration", str(duration_s), "--rate-scale", str(rate_scale),
+        "--deadline-ms", str(deadline_ms), "--workers", "256",
+        "--verify", "stub:0.005", "--json",
+    ]
+    reports = {}
+    for label, extra in (("off", []), ("on", ["--watchtower"])):
+        leg_timeout = min(120.0, _budget_left() - 60)
+        if leg_timeout < 45:
+            return {"skipped": "budget"}
+        try:
+            r = subprocess.run(
+                base_args + extra, capture_output=True, text=True,
+                timeout=leg_timeout, env=env,
+            )
+        except subprocess.TimeoutExpired:
+            return {"skipped": f"timeout>{leg_timeout:.0f}s"}
+        if r.returncode != 0:
+            return {"error": f"{label}: rc={r.returncode}: {r.stderr[-200:]}"}
+        try:
+            reports[label] = json.loads(r.stdout.strip().splitlines()[-1])
+        except (ValueError, IndexError):
+            return {"error": f"{label}: unparseable: {r.stdout[-200:]}"}
+
+    def rate(rep):
+        return rep["n_sets"] / rep["wall_s"] if rep["wall_s"] else 0.0
+
+    rate_off, rate_on = rate(reports["off"]), rate(reports["on"])
+    overhead = (rate_off - rate_on) / rate_off if rate_off else None
+    wt = reports["on"].get("watchtower") or {}
+    lead = wt.get("lead") or {}
+    incidents = wt.get("incidents") or []
+    return {
+        "generator": "saturation_ramp",
+        "seed": seed,
+        "rate_scale": rate_scale,
+        "deadline_ms": deadline_ms,
+        "verify_backend": reports["on"]["config"]["verify_backend"],
+        "n_sets": reports["on"]["n_sets"],
+        "sets_per_sec_off": round(rate_off, 2),
+        "sets_per_sec_on": round(rate_on, 2),
+        "overhead_ratio": round(overhead, 4) if overhead is not None else None,
+        "overhead_under_1pct": (
+            overhead is not None and overhead < 0.01
+        ),
+        "n_incidents": lead.get("n_incidents"),
+        "first_incident_detector": lead.get("first_incident_detector"),
+        "first_incident_t": lead.get("first_incident_t"),
+        "first_miss_burst_t": lead.get("first_miss_burst_t"),
+        "lead_time_s": lead.get("lead_time_s"),
+        "incident_detectors": sorted(
+            {i.get("detector") for i in incidents if i.get("detector")}
+        ),
+    }
+
+
 def measure_dp_leg(
     n_sets: int = 16, reps: int = 3, messages: int = 2
 ) -> dict:
@@ -1594,6 +1678,19 @@ def main() -> None:
         except Exception as e:  # the leg must not kill the line
             epoch_flood_leg = {"error": str(e)[:200]}
 
+    # Watchtower leg (ISSUE 18): the acceptance saturation ramp with
+    # the anomaly evaluator off vs on — evaluator overhead (flagged
+    # against the <1% budget) and the measured detection lead of the
+    # headroom page over the first miss burst. Stub-backend
+    # subprocesses, seconds. Both numbers learned by bench_diff.
+    if _budget_left() < 120:
+        watchtower_leg = {"skipped": "budget"}
+    else:
+        try:
+            watchtower_leg = measure_watchtower_leg(use_cpu)
+        except Exception as e:  # the leg must not kill the line
+            watchtower_leg = {"error": str(e)[:200]}
+
     # Served multi-chip dp verify, 1 vs 2 virtual devices (ISSUE 11):
     # per-chip + aggregate sets/s through the real scheduler/planner/
     # backend stack. Subprocesses (XLA_FLAGS must precede jax init),
@@ -1696,6 +1793,7 @@ def main() -> None:
                 "chaos_leg": chaos_leg,
                 "bulk_leg": bulk_leg,
                 "epoch_flood_leg": epoch_flood_leg,
+                "watchtower_leg": watchtower_leg,
                 "dp_leg": dp_leg,
                 "startup": startup,
                 "buckets": buckets,

@@ -94,6 +94,14 @@ class ClientConfig:
     # Only effective with bls_backend="tpu"; LIGHTHOUSE_TPU_DP_MESH=0
     # disables the mesh entirely.
     dp_devices: Optional[int] = None
+    # the watchtower (utils/watchtower.py, ISSUE 18): the background
+    # evaluator walking the detector catalogue over the timeseries
+    # store + slot ledger, latching incidents and writing correlated
+    # forensic bundles. None = env LIGHTHOUSE_TPU_WATCHTOWER (default
+    # on); evaluator cadence/bundle knobs stay env-tunable
+    # (LIGHTHOUSE_TPU_WT_INTERVAL_S / _WT_COOLDOWN_S / _WT_BUNDLE /
+    # _WT_BUNDLE_DIR / _WT_MAX_INCIDENTS, docs/OBSERVABILITY.md).
+    watchtower: Optional[bool] = None
     # device-side operation_pool aggregation (ISSUE 16): route the
     # pool's G2 signature point-sums through the windowed-MSM surface
     # (operation_pool/device_agg.py; programs warmed on the compile
@@ -123,18 +131,32 @@ class Client:
         # sampler that feeds /lighthouse/timeseries and the headroom
         # estimate in the health `capacity` block. No-op (free) when
         # LIGHTHOUSE_TPU_TIMESERIES=0.
-        from .utils import timeseries
+        from .utils import timeseries, watchtower
 
         if timeseries.enabled():
             timeseries.start_sampler()
+        # the watchtower (ISSUE 18): background detector evaluation
+        # over the store the sampler just started feeding; incident
+        # bundles snapshot the same (TTL-cached) health document the
+        # endpoint serves. No-op when LIGHTHOUSE_TPU_WATCHTOWER=0 or
+        # config.watchtower=False.
+        if watchtower.enabled():
+            if self.api is not None:
+                watchtower.set_health_provider(self.api._health_doc)
+            watchtower.start_evaluator()
         self._timer.start()
         return self
 
     def stop(self):
         try:
             self._stop.set()
-            from .utils import timeseries
+            from .utils import timeseries, watchtower
 
+            # evaluator before sampler: a final tick against a live
+            # store beats one against a stopping one; the provider is
+            # cleared so bundles never call a stopped server's cache
+            watchtower.stop_evaluator()
+            watchtower.set_health_provider(None)
             timeseries.stop_sampler()
             if self.api is not None:
                 self.api.stop()
@@ -562,6 +584,13 @@ class ClientBuilder:
                     network.connect(host, int(port))
                 except (ValueError, OSError):
                     pass
+        # the watchtower config seam (ISSUE 18): an explicit
+        # cfg.watchtower overrides the LIGHTHOUSE_TPU_WATCHTOWER env
+        # default; None leaves the env knob in charge
+        if cfg.watchtower is not None:
+            from .utils import watchtower as _watchtower
+
+            _watchtower.configure(enabled=cfg.watchtower)
         api = (
             BeaconApiServer(chain, cfg.http_host, cfg.http_port)
             if cfg.http_enabled

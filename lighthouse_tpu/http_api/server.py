@@ -44,17 +44,20 @@ consume):
     GET  /eth/v1/beacon/states/{state_id}/validators/{validator_id}
     GET  /eth/v1/beacon/deposit_snapshot
     GET  /eth/v1/debug/beacon/heads
-    GET  /lighthouse/health
+    GET  /lighthouse/health (short-TTL cached snapshot, see below)
     GET  /lighthouse/timeseries (?family=&window=&tier= filters)
     GET  /lighthouse/slots (?view=slots|epochs, ?last=N)
+    GET  /lighthouse/incidents (?limit=N, ?open=1 — the watchtower ledger)
     GET  /metrics
 """
 
 from __future__ import annotations
 
 import json
+import os
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
@@ -80,6 +83,128 @@ _HTTP_SECONDS = metrics.histogram(
     "http_api_request_seconds", "beacon API request handling wall time"
 )
 
+# /lighthouse/health snapshot TTL (ISSUE 18 satellite): assembling the
+# document walks EVERY collector — scheduler, ledgers, profiler, mesh,
+# capacity — so concurrent scrapers (dashboards + the watchtower
+# evaluator's health provider) must not multiply that cost on the HTTP
+# threads. 0 disables caching (every scrape re-collects).
+try:
+    _HEALTH_TTL_S = max(
+        0.0, float(os.environ.get("LIGHTHOUSE_TPU_HEALTH_TTL_S", "") or 1.0)
+    )
+except ValueError:
+    _HEALTH_TTL_S = 1.0
+
+
+def build_health_doc(chain) -> dict:
+    """Assemble the ONE consolidated node-health document (reference:
+    the lighthouse-specific API namespace pulls common/system_health +
+    monitoring_api process/beacon data): host stats, process + beacon-
+    node state, beacon-processor queue depths, peer counts, and every
+    instrument's own block — the page an operator reads first when the
+    node misbehaves. Module-level so the watchtower's incident bundles
+    can snapshot the same document the endpoint serves; callers wanting
+    the short-TTL cache go through ``BeaconApiServer._health_doc``."""
+    from ..utils import (
+        fault_injection,
+        flight_recorder,
+        monitoring,
+        pipeline_profiler,
+        slot_ledger,
+        system_health,
+        timeseries,
+        transfer_ledger,
+        watchtower,
+    )
+
+    doc = {"system": system_health.observe()}
+    try:
+        doc.update(monitoring.collect(chain))
+    except Exception as e:  # a degraded chain still reports hosts
+        doc["collect_error"] = repr(e)
+    proc = getattr(chain, "beacon_processor", None)
+    doc["beacon_processor"] = (
+        None
+        if proc is None
+        else {
+            "queues": proc.queue_lengths(),
+            "dropped_total": metrics.get(
+                "beacon_processor_dropped_total"
+            ).value,
+        }
+    )
+    # derived from the collected doc: one transport read, one fact —
+    # and UNKNOWN (null) when collect failed, never a fabricated
+    # "0 peers" on the page operators read first
+    bn = doc.get("beacon_node")
+    doc["network"] = (
+        None if bn is None else {"peer_count": bn.get("peers", 0)}
+    )
+    doc["flight_recorder"] = flight_recorder.status()
+    # continuous-batching scheduler: queue depth + batch occupancy
+    # (null when the chain runs without one)
+    sched = getattr(chain, "verification_scheduler", None)
+    doc["verification_scheduler"] = (
+        None if sched is None else sched.status()
+    )
+    # verdict-latency SLO: rolling p50/p99 + deadline-miss ratio per
+    # caller kind over the scheduler's sample window (null when the
+    # chain runs without a scheduler) — the page that answers "what
+    # are submitters experiencing right now", certified offline by
+    # tools/traffic_replay.py (docs/TRAFFIC_REPLAY.md)
+    doc["slo"] = None if sched is None else sched.slo_summary()
+    # AOT compile service: warm-shape surface, compile queue and
+    # persistent-cache state (null when the node runs without one)
+    csvc = getattr(chain, "compile_service", None)
+    doc["compile_service"] = None if csvc is None else csvc.status()
+    # data-movement ledger (ISSUE 8): per-operand/per-kind H2D bytes,
+    # pack-phase seconds + pack share of verify wall, repeat-pubkey
+    # re-upload window, device memory — the evidence base for the
+    # device-resident pubkey table (ROADMAP item 2); rendered by
+    # tools/transfer_report.py
+    doc["data_movement"] = transfer_ledger.summary()
+    # device-resident pubkey table (ISSUE 10): residency, index-shipped
+    # vs raw-shipped sets (hit ratio), the aggregate-sum cache and
+    # upload accounting (null when the node runs without one)
+    ktable = getattr(chain, "device_key_table", None)
+    doc["key_table"] = None if ktable is None else ktable.status()
+    # served dp mesh (ISSUE 11): per-chip sets/s, shard health,
+    # per-chip device memory and the aggregate throughput the dp axis
+    # delivers (null when the node runs single-device)
+    dmesh = getattr(chain, "device_mesh", None)
+    doc["mesh"] = None if dmesh is None else dmesh.status()
+    # pipeline-occupancy profiler (ISSUE 12): per-shard device bubble
+    # ratios with cause attribution, flush critical-path phase totals,
+    # flush-thread saturation and the overlap-potential projection —
+    # the evidence base for ROADMAP item 5; rendered by
+    # tools/pipeline_report.py
+    doc["pipeline"] = pipeline_profiler.summary()
+    # fault injection (ISSUE 13): armed fault points + their
+    # call/injection counters — served ONLY while a chaos run is
+    # armed; a production node without chaos config shows null here
+    # (and pays one global check per fault seam)
+    doc["fault_injection"] = (
+        fault_injection.status() if fault_injection.armed() else None
+    )
+    # capacity & saturation (ISSUE 14): the timeseries sampler's state
+    # + memory accounting, the sampled family catalogue and the latest
+    # capacity/headroom estimate — the dial ROADMAP item 2's admission
+    # control reads; history at /lighthouse/timeseries, rendered by
+    # tools/capacity_report.py
+    doc["capacity"] = timeseries.capacity_summary()
+    # chain-time attribution (ISSUE 17): the slot ledger's rollup
+    # state — current slot/epoch, retained report cards, lifetime
+    # totals and the latest epoch's first-sighting ratio (ROADMAP
+    # item 3's go/no-go dial); per-slot cards at /lighthouse/slots,
+    # rendered by tools/slot_report.py
+    doc["chain_time"] = slot_ledger.summary()
+    # the watchtower (ISSUE 18): per-detector state (armed/firing/
+    # latched/cooldown), incident accounting, evaluator + bundle
+    # config; the incident ledger itself at /lighthouse/incidents,
+    # bundles rendered by tools/incident_report.py
+    doc["watchtower"] = watchtower.summary()
+    return doc
+
 
 class ApiError(Exception):
     def __init__(self, status: int, message: str):
@@ -104,6 +229,11 @@ class BeaconApiServer:
         self._payload_cache_cap = 8
         # handlers run on ThreadingHTTPServer threads: insert/evict/pop race
         self._payload_cache_lock = threading.Lock()
+        # short-TTL /lighthouse/health snapshot (ISSUE 18 satellite):
+        # N concurrent scrapes inside the TTL do ONE underlying collect
+        # (pinned by the stampede test); (monotonic_t, doc)
+        self._health_lock = threading.Lock()
+        self._health_cache: tuple = (0.0, None)
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -129,6 +259,22 @@ class BeaconApiServer:
     def stop(self):
         self.httpd.shutdown()
         self.httpd.server_close()
+
+    def _health_doc(self) -> dict:
+        """The ``/lighthouse/health`` document through the short-TTL
+        snapshot cache: scrapes landing inside ``_HEALTH_TTL_S`` of the
+        last collect are served the cached document; the collect runs
+        UNDER the lock so a thundering herd does exactly one walk. TTL
+        0 disables caching."""
+        if _HEALTH_TTL_S <= 0:
+            return build_health_doc(self.chain)
+        with self._health_lock:
+            t, doc = self._health_cache
+            if doc is not None and time.monotonic() - t < _HEALTH_TTL_S:
+                return doc
+            doc = build_health_doc(self.chain)
+            self._health_cache = (time.monotonic(), doc)
+            return doc
 
     # -- plumbing --------------------------------------------------------
 
@@ -380,108 +526,10 @@ class BeaconApiServer:
         if path == "/metrics":
             return metrics.gather()
         if path == "/lighthouse/health":
-            # ONE consolidated node-health document (reference: the
-            # lighthouse-specific API namespace pulls common/system_health
-            # + monitoring_api process/beacon data): host stats, process
-            # + beacon-node state, beacon-processor queue depths, peer
-            # counts and the flight recorder's own status — the page an
-            # operator reads first when the node misbehaves.
-            from ..utils import flight_recorder, monitoring, system_health
-
-            doc = {"system": system_health.observe()}
-            try:
-                doc.update(monitoring.collect(chain))
-            except Exception as e:  # a degraded chain still reports hosts
-                doc["collect_error"] = repr(e)
-            proc = getattr(chain, "beacon_processor", None)
-            doc["beacon_processor"] = (
-                None
-                if proc is None
-                else {
-                    "queues": proc.queue_lengths(),
-                    "dropped_total": metrics.get(
-                        "beacon_processor_dropped_total"
-                    ).value,
-                }
-            )
-            # derived from the collected doc: one transport read, one
-            # fact — and UNKNOWN (null) when collect failed, never a
-            # fabricated "0 peers" on the page operators read first
-            bn = doc.get("beacon_node")
-            doc["network"] = (
-                None if bn is None else {"peer_count": bn.get("peers", 0)}
-            )
-            doc["flight_recorder"] = flight_recorder.status()
-            # continuous-batching scheduler: queue depth + batch occupancy
-            # (null when the chain runs without one)
-            sched = getattr(chain, "verification_scheduler", None)
-            doc["verification_scheduler"] = (
-                None if sched is None else sched.status()
-            )
-            # verdict-latency SLO: rolling p50/p99 + deadline-miss ratio
-            # per caller kind over the scheduler's sample window (null
-            # when the chain runs without a scheduler) — the page that
-            # answers "what are submitters experiencing right now",
-            # certified offline by tools/traffic_replay.py
-            # (docs/TRAFFIC_REPLAY.md)
-            doc["slo"] = None if sched is None else sched.slo_summary()
-            # AOT compile service: warm-shape surface, compile queue and
-            # persistent-cache state (null when the node runs without one)
-            csvc = getattr(chain, "compile_service", None)
-            doc["compile_service"] = None if csvc is None else csvc.status()
-            # data-movement ledger (ISSUE 8): per-operand/per-kind H2D
-            # bytes, pack-phase seconds + pack share of verify wall,
-            # repeat-pubkey re-upload window, device memory — the
-            # evidence base for the device-resident pubkey table
-            # (ROADMAP item 2); rendered by tools/transfer_report.py
-            from ..utils import transfer_ledger
-
-            doc["data_movement"] = transfer_ledger.summary()
-            # device-resident pubkey table (ISSUE 10): residency,
-            # index-shipped vs raw-shipped sets (hit ratio), the
-            # aggregate-sum cache and upload accounting (null when the
-            # node runs without one)
-            ktable = getattr(chain, "device_key_table", None)
-            doc["key_table"] = None if ktable is None else ktable.status()
-            # served dp mesh (ISSUE 11): per-chip sets/s, shard health,
-            # per-chip device memory and the aggregate throughput the
-            # dp axis delivers (null when the node runs single-device)
-            dmesh = getattr(chain, "device_mesh", None)
-            doc["mesh"] = None if dmesh is None else dmesh.status()
-            # pipeline-occupancy profiler (ISSUE 12): per-shard device
-            # bubble ratios with cause attribution, flush critical-path
-            # phase totals, flush-thread saturation and the overlap-
-            # potential projection — the evidence base for ROADMAP
-            # item 5; rendered by tools/pipeline_report.py
-            from ..utils import pipeline_profiler
-
-            doc["pipeline"] = pipeline_profiler.summary()
-            # fault injection (ISSUE 13): armed fault points + their
-            # call/injection counters — served ONLY while a chaos run
-            # is armed; a production node without chaos config shows
-            # null here (and pays one global check per fault seam)
-            from ..utils import fault_injection
-
-            doc["fault_injection"] = (
-                fault_injection.status() if fault_injection.armed() else None
-            )
-            # capacity & saturation (ISSUE 14): the timeseries sampler's
-            # state + memory accounting, the sampled family catalogue
-            # and the latest capacity/headroom estimate — the dial
-            # ROADMAP item 2's admission control will read; history at
-            # /lighthouse/timeseries, rendered by tools/capacity_report.py
-            from ..utils import timeseries
-
-            doc["capacity"] = timeseries.capacity_summary()
-            # chain-time attribution (ISSUE 17): the slot ledger's
-            # rollup state — current slot/epoch, retained report cards,
-            # lifetime totals and the latest epoch's first-sighting
-            # ratio (ROADMAP item 3's go/no-go dial); per-slot cards at
-            # /lighthouse/slots, rendered by tools/slot_report.py
-            from ..utils import slot_ledger
-
-            doc["chain_time"] = slot_ledger.summary()
-            return {"data": doc}
+            # the consolidated node-health document (assembled by
+            # build_health_doc) through the short-TTL snapshot cache —
+            # concurrent scrapes do ONE collector walk per TTL
+            return {"data": self._health_doc()}
         if path == "/lighthouse/flight_recorder":
             # live journal tail: ?kind=a,b filters, ?limit=N bounds the
             # reply (newest events win); recorder status rides along
@@ -566,6 +614,37 @@ class BeaconApiServer:
                     "rows": rows,
                     "lifetime": slot_ledger.lifetime_totals(),
                     "evicted": slot_ledger.evicted_totals(),
+                }
+            }
+        if path == "/lighthouse/incidents":
+            # the watchtower's incident ledger (ISSUE 18): ?limit=N
+            # keeps the newest rows, ?open=1 filters to still-open
+            # incidents; the per-detector state block and the declared
+            # catalogue ride along so one fetch answers "what is
+            # armed, what fired, and what does it watch". Bundles on
+            # disk (schema lighthouse_tpu.incident/1) are rendered by
+            # tools/incident_report.py.
+            from ..utils import watchtower
+
+            limit = None
+            if "limit" in query:
+                try:
+                    limit = int(query["limit"])
+                except ValueError:
+                    raise ApiError(400, "malformed limit parameter")
+                if limit < 0:
+                    raise ApiError(400, "malformed limit parameter")
+            open_q = query.get("open", "0")
+            if open_q not in ("0", "1"):
+                raise ApiError(400, "malformed open parameter")
+            return {
+                "data": {
+                    "bundle_schema": watchtower.SCHEMA,
+                    "watchtower": watchtower.summary(),
+                    "catalogue": watchtower.catalogue(),
+                    "incidents": watchtower.incidents(
+                        limit=limit, open_only=open_q == "1"
+                    ),
                 }
             }
 

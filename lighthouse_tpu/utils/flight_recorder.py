@@ -67,6 +67,8 @@ EVENT_KINDS = (
     "compile_started",        # compile_service/service.py, per AOT rung
     "deadline_miss",          # verification_service/batcher.py, SLO miss
     "fault_injected",         # utils/fault_injection.py, one per injected fault
+    "incident_opened",        # utils/watchtower.py, detector latched an incident
+    "incident_resolved",      # utils/watchtower.py, breach cleared + duration
     "key_table_reset",        # crypto/device/key_table.py, agg region recycle
     "key_table_sync",         # crypto/device/key_table.py, startup/delta rows
     "log",                    # utils/logging.py, warn/error/crit lines

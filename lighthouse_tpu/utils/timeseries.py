@@ -140,12 +140,22 @@ SAMPLE_FAMILIES: Tuple[FamilySpec, ...] = (
     FamilySpec("capacity_headroom_ratio", "derived", None, None),
     FamilySpec("capacity_occupancy_ratio", "gauge",
                "verification_scheduler_batch_occupancy_ratio", None),
+    # watchtower inputs (ISSUE 18): the key-table reupload ratio, the
+    # recompile rate and the SLO burn rate as HISTORY, so the drift /
+    # burst / rate-of-change detectors have a window to stand on (the
+    # live gauges alone have no time axis)
+    FamilySpec("capacity_pubkey_reupload_ratio", "gauge",
+               "bls_device_pubkey_reupload_ratio", "kind"),
     FamilySpec("capacity_queue_depth", "gauge",
                "verification_scheduler_queue_depth", None),
+    FamilySpec("capacity_recompiles_per_sec", "rate",
+               "bls_device_recompiles_total", None),
     FamilySpec("capacity_shard_bubble_ratio", "ratio",
                "bls_device_bubble_seconds_total", "shard"),
     FamilySpec("capacity_shard_sets_per_sec", "rate",
                "bls_device_shard_sets_total", "shard"),
+    FamilySpec("capacity_slo_burn_rate", "gauge",
+               "verification_scheduler_slo_burn_rate", "kind"),
     FamilySpec("capacity_utilization", "derived", None, None),
     # sets_total, NOT submissions_total: a backfill submission carries
     # 48-128 sets, so a per-submission rate would read ~100x under the

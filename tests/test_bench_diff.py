@@ -70,6 +70,14 @@ def _bench_doc(sets_per_sec, waste, wrapped=False, kt_bytes=45.0,
             "bulk_sets_per_sec": 400.0,
             "throttle_excursions": 1,
         },
+        # ISSUE 18: the watchtower leg's lead/overhead are learned
+        # (never gated) — present so the diff rows render
+        "watchtower_leg": {
+            "lead_time_s": 3.5,
+            "overhead_ratio": 0.002,
+            "overhead_under_1pct": True,
+            "n_incidents": 1,
+        },
     }
     return {"n": 1, "rc": 0, "parsed": doc} if wrapped else doc
 

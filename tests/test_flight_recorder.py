@@ -308,6 +308,9 @@ def test_endpoints_roundtrip_without_validator_client(recorder):
 
         proc = BeaconProcessor(handlers={}, n_workers=0)
         chain.beacon_processor = proc
+        # drop the health snapshot cache (ISSUE 18: /lighthouse/health
+        # serves through a ~1 s TTL) so the refetch sees the processor
+        server._health_cache = (0.0, None)
         try:
             with urllib.request.urlopen(
                 base + "/lighthouse/health", timeout=5

@@ -631,6 +631,9 @@ def test_health_endpoint_serves_slo_block(fake_backend, recorder):
 
         sched = _scheduler()
         chain.verification_scheduler = sched
+        # drop the health snapshot cache (ISSUE 18: /lighthouse/health
+        # serves through a ~1 s TTL) so the refetch sees the scheduler
+        server._health_cache = (0.0, None)
         try:
             assert sched.submit([_set()], "unaggregated").result(5) is True
             with urllib.request.urlopen(

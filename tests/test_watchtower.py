@@ -1,0 +1,605 @@
+"""The watchtower (ISSUE 18): detector unit matrix (fire/no-fire edges
+for every algorithm, hysteresis latching, cooldown dedup), 8-thread
+store writers under a hammering evaluator, the disabled-path <1µs pin,
+incident-bundle parse + renderer round-trips (incident_report /
+forensics_report / slot_report all read the same bundle), the
+``/lighthouse/incidents`` endpoint + health ``watchtower`` block + the
+TTL health cache's stampede pin (no ``cryptography`` anywhere on the
+path), the jax-free subprocess pin, and the replay acceptance gates:
+a saturation ramp latches exactly ONE ``headroom_floor`` incident
+strictly BEFORE the first deadline-miss burst (positive measured lead
+time), and ``gossip_steady`` at nominal load latches ZERO."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from lighthouse_tpu.utils import flight_recorder as fr
+from lighthouse_tpu.utils import timeseries, watchtower
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def wt(tmp_path):
+    """Enabled watchtower with a fresh store, a fresh journal, bundles
+    parked under tmp_path; everything restored afterwards."""
+    prev_fr = fr.configure(
+        capacity=4096, enabled=True, dump=False, dump_dir=str(tmp_path)
+    )
+    fr.clear()
+    timeseries.reset()
+    prev_ts = timeseries.configure(enabled=True)
+    watchtower.reset()
+    prev = watchtower.configure(
+        enabled=True, cooldown_s=5.0, bundle=True,
+        bundle_dir=str(tmp_path / "incidents"), bundle_retain=8,
+    )
+    try:
+        yield
+    finally:
+        watchtower.stop_evaluator()
+        watchtower.configure(**prev)
+        watchtower.reset()
+        timeseries.configure(**prev_ts)
+        timeseries.reset()
+        fr.configure(**prev_fr)
+        fr.clear()
+
+
+def _feed(family, values, t0, dt=1.0, label=""):
+    store = timeseries.get_store()
+    for i, v in enumerate(values):
+        store.record(family, v, t=t0 + i * dt, label=label)
+
+
+def _state(detector, label=""):
+    d = watchtower.summary()["detectors"][detector]
+    lab = d["labels"].get(label)
+    return lab["state"] if lab else d["state"]
+
+
+# ---------------------------------------------------------------------------
+# Detector unit matrix: fire/no-fire edges per algorithm
+# ---------------------------------------------------------------------------
+
+
+def test_floor_hysteresis_cooldown_and_dedup(wt):
+    """The headroom floor detector walks the full lifecycle: armed →
+    (sustain) firing → latched in the hysteresis band → cooldown on
+    clear → REOPEN of the same incident on a re-breach inside the
+    cooldown (flaps, not a second row)."""
+    t0 = time.time()
+    # above the floor: no incident, state stays armed
+    _feed("capacity_headroom_ratio", [0.6], t0)
+    watchtower.evaluate(now=t0)
+    assert _state("headroom_floor") == "armed"
+    assert watchtower.incidents() == []
+
+    # one breaching eval is NOT enough (sustain=2) ...
+    _feed("capacity_headroom_ratio", [0.1], t0 + 1)
+    r = watchtower.evaluate(now=t0 + 1)
+    assert r["transitions"] == []
+    # ... the second one latches exactly one incident
+    _feed("capacity_headroom_ratio", [0.08], t0 + 2)
+    r = watchtower.evaluate(now=t0 + 2)
+    assert [t["action"] for t in r["transitions"]] == ["open"]
+    (inc,) = watchtower.incidents()
+    assert inc["detector"] == "headroom_floor"
+    assert inc["severity"] == "page"
+    assert inc["resolved_t"] is None
+    assert _state("headroom_floor") == "firing"
+
+    # hysteresis band (above floor 0.2, below clear 0.35): the incident
+    # stays OPEN, latched — a sustained breach is ONE incident with a
+    # duration, not a flap storm
+    _feed("capacity_headroom_ratio", [0.3], t0 + 3)
+    assert watchtower.evaluate(now=t0 + 3)["transitions"] == []
+    assert _state("headroom_floor") == "latched"
+    assert watchtower.incidents(open_only=True)
+
+    # clearing above 0.35 resolves with a duration and starts cooldown
+    _feed("capacity_headroom_ratio", [0.5], t0 + 4)
+    r = watchtower.evaluate(now=t0 + 4)
+    assert [t["action"] for t in r["transitions"]] == ["resolve"]
+    (inc,) = watchtower.incidents()
+    assert inc["resolved_t"] is not None
+    assert inc["duration_s"] == pytest.approx(2.0)
+    assert _state("headroom_floor") == "cooldown"
+
+    # a re-breach INSIDE the cooldown reopens the SAME incident
+    _feed("capacity_headroom_ratio", [0.05], t0 + 5)
+    r = watchtower.evaluate(now=t0 + 5)
+    assert [t["action"] for t in r["transitions"]] == ["reopen"]
+    incs = watchtower.incidents()
+    assert len(incs) == 1  # dedup: still one ledger row
+    assert incs[0]["flaps"] == 1
+    assert incs[0]["resolved_t"] is None
+
+    # clear again, then wait out the cooldown: back to armed
+    _feed("capacity_headroom_ratio", [0.6], t0 + 6)
+    watchtower.evaluate(now=t0 + 6)
+    assert _state("headroom_floor") == "cooldown"
+    watchtower.evaluate(now=t0 + 12)  # past cooldown_s=5
+    assert _state("headroom_floor") == "armed"
+
+
+def test_ceil_and_roc_edges(wt):
+    """recompile_burst (ceil) and slo_burn_spike (roc) fire exactly at
+    their declared edges and stay quiet below them."""
+    t0 = time.time()
+    # ceil threshold 0.5: at the threshold is NOT a breach
+    _feed("capacity_recompiles_per_sec", [0.5, 0.5], t0)
+    watchtower.evaluate(now=t0)
+    watchtower.evaluate(now=t0 + 1)
+    assert not [
+        i for i in watchtower.incidents()
+        if i["detector"] == "recompile_burst"
+    ]
+    _feed("capacity_recompiles_per_sec", [0.8, 0.9], t0 + 2)
+    watchtower.evaluate(now=t0 + 2)
+    r = watchtower.evaluate(now=t0 + 3)
+    burst = [
+        t for t in r["transitions"] if t["detector"] == "recompile_burst"
+    ]
+    assert [t["action"] for t in burst] == ["open"]
+
+    # roc threshold 0.2/s over a 60 s window, min_points=3: a slow
+    # creep (0.1/s) stays quiet, a spike (1.0/s) pages on one eval
+    _feed("capacity_slo_burn_rate", [0.0, 1.0, 2.0], t0, dt=10.0,
+          label="deadline")
+    r = watchtower.evaluate(now=t0 + 20)
+    assert not [
+        t for t in r["transitions"] if t["detector"] == "slo_burn_spike"
+    ]
+    _feed("capacity_slo_burn_rate", [12.0, 22.0], t0 + 21, dt=1.0,
+          label="deadline")
+    r = watchtower.evaluate(now=t0 + 22)
+    spike = [
+        t for t in r["transitions"] if t["detector"] == "slo_burn_spike"
+    ]
+    assert [t["action"] for t in spike] == ["open"]
+    (inc,) = [
+        i for i in watchtower.incidents()
+        if i["detector"] == "slo_burn_spike"
+    ]
+    assert inc["label"] == "deadline"
+    assert inc["trigger"]["slope_per_s"] >= 0.2
+
+
+def test_zscore_baseline_gates(wt):
+    """verdict-p99 drift via the slot-card probe is gated on BOTH the
+    z-score and the absolute min_delta: a stable baseline with a tiny
+    wiggle never fires; a genuine drift (>= max(4σ, 10 ms)) does after
+    ``sustain`` evals. The probe dedups per slot, so the baseline is
+    slots, not evaluator ticks."""
+    from lighthouse_tpu.utils import slot_clock, slot_ledger
+
+    prev = slot_ledger.configure(enabled=True)
+    slot_ledger.reset()
+    prev_clock = slot_clock.set_clock(
+        slot_clock.ManualSlotClock(
+            genesis_time=0, seconds_per_slot=12, slots_per_epoch=32
+        )
+    )
+    try:
+        t0 = time.time()
+        now = t0
+        # 20 baseline slots at ~20 ms p99 (tiny wiggle) — builds the
+        # probe history without firing. The count matters: after the
+        # first breaching eval the outlier joins the zscore baseline,
+        # and with m constant points + 1 step outlier sustain survives
+        # only when sqrt(m-1) >= z (m >= 17 at z=4).
+        for s in range(20):
+            for _ in range(20):
+                slot_ledger.note_resolution(
+                    "aggregate", "fused", 1, 0.020 + 0.0001 * (s % 3),
+                    slot=s,
+                )
+            # close the card by advancing the clock past the slot
+            slot_ledger.note_resolution(
+                "aggregate", "fused", 1, 0.020, slot=s + 1
+            )
+            now += 1
+            watchtower.evaluate(now=now)
+        assert not [
+            i for i in watchtower.incidents()
+            if i["detector"] == "verdict_p99_drift"
+        ]
+        # two drifted slots at 90 ms: deviation ~70 ms >> max(4σ, 10ms)
+        for s in (21, 22):
+            for _ in range(20):
+                slot_ledger.note_resolution(
+                    "aggregate", "fused", 1, 0.090, slot=s
+                )
+            slot_ledger.note_resolution(
+                "aggregate", "fused", 1, 0.090, slot=s + 1
+            )
+            now += 1
+            watchtower.evaluate(now=now)
+        (inc,) = [
+            i for i in watchtower.incidents()
+            if i["detector"] == "verdict_p99_drift"
+        ]
+        assert inc["trigger"]["algo"] == "zscore"
+        assert inc["trigger"]["deviation"] >= inc["trigger"]["gate"]
+    finally:
+        slot_clock.set_clock(prev_clock)
+        slot_ledger.configure(**prev)
+        slot_ledger.reset()
+
+
+def test_journal_kinds_and_metrics(wt):
+    """Opening and resolving an incident journals ``incident_opened`` /
+    ``incident_resolved`` with the declared fields and moves the
+    watchtower_* families."""
+    from lighthouse_tpu.utils import metrics
+
+    t0 = time.time()
+    # feed and evaluate in lockstep so each eval sees that step's value
+    # as the newest point (pre-feeding everything would leave 0.6 as
+    # the last-in-window value for every eval)
+    for i, v in enumerate([0.1, 0.1, 0.6]):
+        _feed("capacity_headroom_ratio", [v], t0 + i)
+        watchtower.evaluate(now=t0 + i)
+    evs = fr.events(kinds=["incident_opened", "incident_resolved"])
+    assert [e["kind"] for e in evs] == ["incident_opened",
+                                       "incident_resolved"]
+    opened = evs[0]["fields"]
+    assert opened["detector"] == "headroom_floor"
+    assert opened["severity"] == "page"
+    assert opened["value"] == pytest.approx(0.1)
+    assert evs[1]["fields"]["duration_s"] == pytest.approx(1.0)
+    fam = metrics.get("watchtower_incidents_total")
+    assert fam.with_labels("headroom_floor", "page").value >= 1
+    assert metrics.get("watchtower_bundles_written_total").value >= 1
+
+
+# ---------------------------------------------------------------------------
+# Concurrency + the disabled pin
+# ---------------------------------------------------------------------------
+
+
+def test_writer_threads_under_hammering_evaluator(wt):
+    """8 threads writing watched series while the evaluator hammers
+    evaluate(): no exception, no torn summary, and the breach the
+    writers produce still latches exactly one headroom incident."""
+    stop = threading.Event()
+    errors = []
+
+    def writer(i):
+        store = timeseries.get_store()
+        # writer 0 owns the headroom series (pinned breaching); the
+        # other 7 hammer non-paging series at steady values
+        fams = ("capacity_recompiles_per_sec", "capacity_slo_burn_rate",
+                "capacity_utilization")
+        n = 0
+        while not stop.is_set():
+            if i == 0:
+                store.record("capacity_headroom_ratio", 0.05)
+            else:
+                store.record(fams[n % len(fams)], 0.5)
+            n += 1
+
+    def hammer():
+        try:
+            while not stop.is_set():
+                watchtower.evaluate()
+                watchtower.summary()
+                watchtower.incidents()
+        except Exception as e:  # pragma: no cover — the failure mode
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=writer, args=(i,)) for i in range(8)
+    ] + [threading.Thread(target=hammer) for _ in range(2)]
+    for t in threads:
+        t.start()
+    time.sleep(1.0)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert not errors
+    # writer 0 pinned headroom at 0.05: exactly one latched incident
+    incs = [
+        i for i in watchtower.incidents()
+        if i["detector"] == "headroom_floor"
+    ]
+    assert len(incs) == 1
+
+
+def test_disabled_evaluate_under_one_microsecond(wt):
+    prev = watchtower.configure(enabled=False)
+    try:
+        assert watchtower.evaluate() is None
+        n = 20_000
+        ev = watchtower.evaluate
+        best = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                ev()
+            best = min(best, (time.perf_counter() - t0) / n)
+        assert best < 1e-6, (
+            f"disabled evaluate() costs {best * 1e9:.0f} ns — too "
+            f"expensive for the always-on seam"
+        )
+    finally:
+        watchtower.configure(**prev)
+
+
+# ---------------------------------------------------------------------------
+# Bundle round-trip: every forensic tool reads the same capture
+# ---------------------------------------------------------------------------
+
+
+def test_bundle_round_trip_and_renderers(wt, tmp_path):
+    """The correlated capture is complete (flight tail, timeseries
+    windows, slot cards, chain time, profiler, capacity), atomically
+    parseable, and all three report tools render it; unknown schemas
+    are rejected with the offending field named."""
+    sys.path.insert(0, REPO)
+    import tools.forensics_report as forensics_report
+    import tools.incident_report as incident_report
+    import tools.slot_report as slot_report
+
+    t0 = time.time()
+    _feed("capacity_headroom_ratio", [0.6, 0.1, 0.1], t0)
+    for i in range(3):
+        watchtower.evaluate(now=t0 + i)
+    (inc,) = watchtower.incidents()
+    path = inc["bundle_path"]
+    assert path and os.path.exists(path)
+
+    doc = incident_report.load(path)
+    assert doc["schema"] == watchtower.SCHEMA
+    for key in ("incident", "detector", "flight_recorder", "timeseries",
+                "slot_cards", "chain_time", "profiler", "capacity",
+                "health", "margin_s"):
+        assert key in doc, key
+    assert doc["incident"]["id"] == inc["id"]
+    assert doc["detector"]["name"] == "headroom_floor"
+    fams = doc["timeseries"]["families"]
+    assert "capacity_headroom_ratio" in fams
+    assert doc["flight_recorder"]["trigger"] == "incident:headroom_floor"
+
+    text = incident_report.render(doc)
+    assert inc["id"] in text and "headroom_floor" in text
+    assert "dials" in text and "tripped" in text
+
+    # forensics_report renders the embedded flight tail from the SAME
+    # file; slot_report normalizes the captured slot cards
+    assert "incident:headroom_floor" in forensics_report.render(
+        forensics_report.load(path)
+    )
+    rep = slot_report.normalize(json.loads(open(path).read()))
+    assert rep["source"] == "incident"
+
+    # unknown schema versions are rejected with field context
+    bad = tmp_path / "bad_bundle.json"
+    bad.write_text(json.dumps({"schema": "lighthouse_tpu.incident/99"}))
+    with pytest.raises(ValueError, match=r"field 'schema'.*incident/99"):
+        incident_report.load(str(bad))
+    with pytest.raises(SystemExit, match=r"field 'schema'"):
+        slot_report.normalize({"schema": "lighthouse_tpu.incident/99"})
+    torn = tmp_path / "torn.json"
+    torn.write_text('{"schema": "lighthouse_tpu.incident/1", ')
+    with pytest.raises(ValueError, match=r"line 1 col"):
+        incident_report.load(str(torn))
+
+    # retention keeps the newest N bundles
+    bdir = os.path.dirname(path)
+    names = [
+        n for n in os.listdir(bdir)
+        if n.startswith(watchtower.BUNDLE_PREFIX)
+    ]
+    assert 0 < len(names) <= 8
+
+
+# ---------------------------------------------------------------------------
+# Endpoint + health block + the TTL cache stampede pin
+# ---------------------------------------------------------------------------
+
+
+def _mini_server():
+    import copy
+
+    from lighthouse_tpu.beacon_chain import BeaconChain
+    from lighthouse_tpu.http_api import BeaconApiServer
+    from lighthouse_tpu.state_transition import store_replayer
+    from lighthouse_tpu.store import HotColdDB, MemoryStore
+    from lighthouse_tpu.testing.harness import StateHarness
+    from lighthouse_tpu.types.chain_spec import minimal_spec
+    from lighthouse_tpu.types.preset import MINIMAL
+    from lighthouse_tpu.utils.slot_clock import ManualSlotClock
+
+    h = StateHarness(
+        MINIMAL, minimal_spec(), validator_count=8, fork_name="phase0",
+        fake_sign=True,
+    )
+    genesis = copy.deepcopy(h.state)
+    db = HotColdDB(
+        MemoryStore(), h.t, h.spec, store_replayer(h.preset, h.spec)
+    )
+    clock = ManualSlotClock(genesis.genesis_time, h.spec.seconds_per_slot)
+    chain = BeaconChain(h.preset, h.spec, h.t, db, genesis, slot_clock=clock)
+    return BeaconApiServer(chain, port=0)
+
+
+def test_incidents_endpoint_health_block_and_cache_stampede(wt, monkeypatch):
+    """/lighthouse/incidents round-trips the ledger + catalogue with the
+    documented grammar (400 on malformed limit/open), /lighthouse/health
+    carries the ``watchtower`` block, and the TTL cache collapses a
+    scrape stampede to ONE collector walk — no ``cryptography``
+    dependency anywhere."""
+    import urllib.error
+    import urllib.request
+
+    from lighthouse_tpu.http_api import server as server_mod
+
+    t0 = time.time()
+    _feed("capacity_headroom_ratio", [0.1, 0.1], t0)
+    watchtower.evaluate(now=t0)
+    watchtower.evaluate(now=t0 + 1)
+
+    server = _mini_server().start()
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(
+            base + "/lighthouse/incidents", timeout=5
+        ) as r:
+            doc = json.load(r)["data"]
+        assert doc["bundle_schema"] == watchtower.SCHEMA
+        assert [d["name"] for d in doc["catalogue"]] == [
+            d.name for d in watchtower.DETECTORS
+        ]
+        (inc,) = doc["incidents"]
+        assert inc["detector"] == "headroom_floor"
+        assert doc["watchtower"]["incidents"]["open"] == 1
+
+        with urllib.request.urlopen(
+            base + "/lighthouse/incidents?limit=0&open=1", timeout=5
+        ) as r:
+            assert json.load(r)["data"]["incidents"] == []
+        for bad in ("limit=abc", "limit=-1", "open=maybe"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    base + "/lighthouse/incidents?" + bad, timeout=5
+                )
+            assert ei.value.code == 400, bad
+
+        with urllib.request.urlopen(
+            base + "/lighthouse/health", timeout=5
+        ) as r:
+            health = json.load(r)["data"]
+        wt_block = health["watchtower"]
+        assert wt_block["enabled"] is True
+        assert wt_block["detectors"]["headroom_floor"]["state"] in (
+            "firing", "latched",
+        )
+
+        # stampede pin: N concurrent scrapes inside the TTL -> exactly
+        # one underlying collector walk
+        calls = []
+        real = server_mod.build_health_doc
+
+        def counting(chain):
+            calls.append(1)
+            return real(chain)
+
+        monkeypatch.setattr(server_mod, "build_health_doc", counting)
+        server._health_cache = (0.0, None)  # invalidate
+        n = 16
+        barrier = threading.Barrier(n)
+        docs = []
+
+        def scrape():
+            barrier.wait()
+            docs.append(server._health_doc())
+
+        threads = [threading.Thread(target=scrape) for _ in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert len(docs) == n
+        assert len(calls) == 1, (
+            f"{len(calls)} collector walks for {n} concurrent scrapes — "
+            f"the TTL cache must collapse the stampede"
+        )
+    finally:
+        server.stop()
+
+
+def test_watchtower_jax_free_subprocess():
+    """The watchtower imports, evaluates, latches and bundles with no
+    jax in the process — the forensic path must work on a box that
+    never initializes a backend."""
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import sys, tempfile, time\n"
+         "from lighthouse_tpu.utils import timeseries, watchtower\n"
+         "timeseries.reset(); watchtower.reset()\n"
+         "watchtower.configure(enabled=True,\n"
+         "    bundle_dir=tempfile.mkdtemp())\n"
+         "s = timeseries.get_store()\n"
+         "t0 = time.time()\n"
+         "for i, v in enumerate([0.6, 0.1, 0.1]):\n"
+         "    s.record('capacity_headroom_ratio', v, t=t0 + i)\n"
+         "    watchtower.evaluate(now=t0 + i)\n"
+         "(inc,) = watchtower.incidents()\n"
+         "assert inc['detector'] == 'headroom_floor'\n"
+         "assert inc['bundle_path']\n"
+         "assert 'jax' not in sys.modules, 'watchtower must stay jax-free'\n"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr
+
+
+# ---------------------------------------------------------------------------
+# Replay acceptance: measured detection lead time
+# ---------------------------------------------------------------------------
+
+
+def _run_replay(args, timeout=180):
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "traffic_replay.py"),
+         *args, "--json"],
+        capture_output=True, text=True, timeout=timeout, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stderr
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
+def test_saturation_ramp_detects_before_first_miss_burst():
+    """THE acceptance gate: on a saturation ramp the headroom detector
+    opens exactly one latched incident, with a complete correlated
+    bundle, strictly BEFORE the first deadline-miss burst — measured
+    lead time > 0 as a first-class report output."""
+    report = _run_replay([
+        "--generate", "saturation_ramp", "--seed", "7",
+        "--duration", "14", "--rate-scale", "2.2",
+        "--verify", "stub:0.005", "--deadline-ms", "250",
+        "--workers", "256", "--watchtower",
+    ])
+    wt_rep = report["watchtower"]
+    lead = wt_rep["lead"]
+    heads = [
+        i for i in wt_rep["incidents"] if i["detector"] == "headroom_floor"
+    ]
+    assert len(heads) == 1, (
+        f"want exactly one latched headroom incident, got {heads}"
+    )
+    assert lead["first_incident_detector"] == "headroom_floor"
+    assert lead["first_miss_burst_t"] is not None, "ramp never saturated"
+    assert lead["lead_time_s"] is not None and lead["lead_time_s"] > 0, (
+        f"headroom incident must open BEFORE the first miss burst: {lead}"
+    )
+    assert lead["first_incident_t"] < lead["first_miss_burst_t"]
+    # the correlated bundle is on disk and complete
+    with open(heads[0]["bundle_path"]) as f:
+        bundle = json.load(f)
+    assert bundle["schema"] == watchtower.SCHEMA
+    assert bundle["incident"]["detector"] == "headroom_floor"
+    assert bundle["flight_recorder"]["events"]
+    assert "capacity_headroom_ratio" in bundle["timeseries"]["families"]
+    assert bundle["slot_cards"]
+
+
+def test_gossip_steady_latches_zero_incidents():
+    """Steady nominal gossip must NOT page: zero incidents, zero
+    deadline-miss bursts, and the report says so."""
+    report = _run_replay([
+        "--generate", "gossip_steady", "--seed", "3",
+        "--duration", "8", "--verify", "stub:0.005",
+        "--deadline-ms", "250", "--workers", "256", "--watchtower",
+    ])
+    wt_rep = report["watchtower"]
+    assert wt_rep["incidents"] == [], wt_rep["incidents"]
+    assert wt_rep["lead"]["n_incidents"] == 0
+    assert wt_rep["lead"]["first_miss_burst_t"] is None

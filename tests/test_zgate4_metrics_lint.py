@@ -52,6 +52,7 @@ KNOWN_PREFIXES = (
     "validator_monitor_",
     "vc_",
     "verification_scheduler_",
+    "watchtower_",  # anomaly watchtower (utils/watchtower.py, ISSUE 18)
 )
 
 _NAME = re.compile(r"[a-z][a-z0-9_]*$")
@@ -76,6 +77,7 @@ def _import_instrumented_modules():
     import lighthouse_tpu.utils.monitoring  # noqa: F401
     import lighthouse_tpu.utils.slot_ledger  # noqa: F401
     import lighthouse_tpu.utils.timeseries  # noqa: F401
+    import lighthouse_tpu.utils.watchtower  # noqa: F401
     import lighthouse_tpu.verification_service.batcher  # noqa: F401
 
 
@@ -601,6 +603,88 @@ def test_slot_ledger_families_registered():
         with pytest.raises(ValueError):
             slot_ledger.note_committee_sighting("zgate4_undeclared")
     import tools.slot_report  # noqa: F401
+
+
+def test_watchtower_families_and_catalogue_registered():
+    """ISSUE 18 families (utils/watchtower.py) exist under their
+    declared types + labels, the detector catalogue reads as a registry
+    (sorted, unique, snake_case, every detector documented in
+    docs/OBSERVABILITY.md with a sane declaration), the incident journal
+    kinds are in the sorted catalogue, and tools/incident_report.py
+    imports cleanly + dry-runs jax-free (subprocess-pinned)."""
+    _import_instrumented_modules()
+    reg = metrics.registry_snapshot()
+    want = {
+        "watchtower_evaluations_total": ("counter", None),
+        "watchtower_evaluator_errors_total": ("counter", None),
+        "watchtower_incidents_total": ("counter", ("detector", "severity")),
+        "watchtower_incidents_open": ("gauge", None),
+        "watchtower_detector_state": ("gauge", ("detector",)),
+        "watchtower_bundles_written_total": ("counter", None),
+    }
+    for name, (kind, labels) in want.items():
+        m = reg.get(name)
+        assert m is not None, f"family {name} not registered"
+        assert m.kind == kind, (name, m.kind)
+        if labels is not None:
+            assert m.labelnames == labels, (name, m.labelnames)
+        else:
+            assert not hasattr(m, "labelnames"), name  # unlabeled family
+    import os
+
+    from lighthouse_tpu.utils import flight_recorder, watchtower
+
+    # the detector catalogue is a registry like EVENT_KINDS: sorted,
+    # unique, snake_case, declared severities/algos only, documented
+    names = [d.name for d in watchtower.DETECTORS]
+    assert names, "detector catalogue must not be empty"
+    assert names == sorted(names)
+    assert len(set(names)) == len(names)
+    docs = open(
+        os.path.join(
+            os.path.dirname(__file__), "..", "docs", "OBSERVABILITY.md"
+        )
+    ).read()
+    for d in watchtower.DETECTORS:
+        assert _NAME.match(d.name), f"detector not snake_case: {d.name!r}"
+        assert d.severity in watchtower.SEVERITIES, (d.name, d.severity)
+        assert d.algo in watchtower.ALGOS, (d.name, d.algo)
+        assert d.window_s > 0 and d.min_points >= 1 and d.sustain >= 1, d.name
+        assert d.source.startswith(("series:", "probe:")), (d.name, d.source)
+        if d.source.startswith("probe:"):
+            assert d.source.partition(":")[2] in watchtower.PROBES, d.source
+        assert d.doc, f"detector {d.name!r} has no doc string"
+        assert f"`{d.name}`" in docs, (
+            f"detector {d.name!r} missing from docs/OBSERVABILITY.md — "
+            f"the catalogue must stay documented"
+        )
+    # the incident schema is versioned like the trace schema, and the
+    # journal kinds are in the sorted recorder catalogue
+    assert re.fullmatch(
+        r"lighthouse_tpu\.incident/\d+", watchtower.SCHEMA
+    ), watchtower.SCHEMA
+    assert "incident_opened" in flight_recorder.EVENT_KINDS
+    assert "incident_resolved" in flight_recorder.EVENT_KINDS
+    # the renderer imports cleanly and its --list-detectors dry run
+    # stays jax-free (the forensic path must work on a dying node
+    # without touching a backend)
+    import subprocess
+    import sys
+
+    import tools.incident_report  # noqa: F401
+
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import sys\n"
+         "import tools.incident_report as ir\n"
+         "ir.main(['--list-detectors'])\n"
+         "assert 'jax' not in sys.modules, "
+         "'incident_report must stay jax-free'\n"],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr
+    assert "headroom_floor" in r.stdout
 
 
 def test_warmup_tool_imports_and_dry_run_lists_ladder(capsys, monkeypatch):

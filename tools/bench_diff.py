@@ -145,6 +145,20 @@ METRICS = (
      ("epoch_flood_leg", "quiet_p99_ms"), None),
     ("epoch_flood_first_sighting_ratio",
      ("epoch_flood_leg", "first_sighting_hit_ratio"), None),
+    # ISSUE 18: the watchtower leg — the anomaly evaluator's economics
+    # on the acceptance saturation ramp. LEARNED, not gated (None
+    # direction): the detection lead (headroom page vs first miss
+    # burst — positive = the pager beat the pain) and the
+    # evaluator-on overhead ratio are stub-backend wall-clock
+    # instruments; the hard acceptance (exactly one page, strictly
+    # positive lead, <1 µs disabled pin) lives in
+    # tests/test_watchtower.py
+    ("watchtower_lead_time_s",
+     ("watchtower_leg", "lead_time_s"), None),
+    ("watchtower_overhead_ratio",
+     ("watchtower_leg", "overhead_ratio"), None),
+    ("watchtower_incidents",
+     ("watchtower_leg", "n_incidents"), None),
 )
 
 # the metrics whose regression exits nonzero (ISSUE 8 throughput/waste

@@ -18,6 +18,10 @@ Usage::
 
     python tools/forensics_report.py /tmp/lighthouse_tpu_flight/<dump>.json
     python tools/forensics_report.py --latest [--dir DIR]   # newest dump
+
+A watchtower incident bundle (schema ``lighthouse_tpu.incident/1``) is
+also accepted: its embedded flight-recorder snapshot renders the same
+way. Unknown schemas are rejected with the offending field named.
 """
 
 from __future__ import annotations
@@ -30,17 +34,40 @@ from collections import Counter
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# the producer owns the schema: a version bump there must fail loudly
+# the producers own the schemas: a version bump there must fail loudly
 # here, not drift against a second literal
 from lighthouse_tpu.utils.flight_recorder import DUMP_PREFIX, SCHEMA  # noqa: E402
+from lighthouse_tpu.utils.watchtower import SCHEMA as INCIDENT_SCHEMA  # noqa: E402
 
 
 def load(path: str) -> dict:
-    with open(path) as f:
-        doc = json.load(f)
-    if doc.get("schema") != SCHEMA:
+    """Load a flight-recorder dump — or a watchtower incident bundle, in
+    which case the embedded flight-recorder snapshot is what renders."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except json.JSONDecodeError as e:
         raise ValueError(
-            f"{path}: schema {doc.get('schema')!r} != expected {SCHEMA!r}"
+            f"{path}: line {e.lineno} col {e.colno}: not valid JSON: {e.msg}"
+        ) from None
+    schema = doc.get("schema")
+    if schema == INCIDENT_SCHEMA:
+        inner = doc.get("flight_recorder")
+        if not isinstance(inner, dict):
+            raise ValueError(
+                f"{path}: field 'flight_recorder': incident bundle carries "
+                f"no flight-recorder snapshot"
+            )
+        if inner.get("schema") != SCHEMA:
+            raise ValueError(
+                f"{path}: field 'flight_recorder.schema': "
+                f"{inner.get('schema')!r} != expected {SCHEMA!r}"
+            )
+        return inner
+    if schema != SCHEMA:
+        raise ValueError(
+            f"{path}: field 'schema': unsupported dump schema {schema!r} "
+            f"(this build reads {SCHEMA!r} or {INCIDENT_SCHEMA!r})"
         )
     return doc
 

@@ -1,0 +1,241 @@
+"""Render a watchtower incident bundle into a human triage timeline.
+
+A bundle (written by ``lighthouse_tpu.utils.watchtower`` when a detector
+latches an incident, schema ``lighthouse_tpu.incident/1``) is the
+correlated capture an operator opens first: the incident row itself, the
+detector's declaration and trigger trace, the dials (timeseries windows
+with pre/post margin), the last slot report cards, chain-time summary,
+profiler attribution, capacity summary, the health doc at capture time,
+and the flight-recorder tail. This tool turns one into the narrative:
+
+* a header: which detector fired, at what severity, when, for how long,
+  with the trigger trace (observed value vs threshold/baseline);
+* the dials — per-family min/max/last over the captured window, with a
+  marker for the family that tripped the detector;
+* the last slot report cards (slot, sets, misses, p99, headroom floor);
+* profiler + capacity one-liners (where the time went, what the node
+  thought its ceiling was);
+* the flight-recorder tail rendered by tools/forensics_report.py — the
+  same timeline/attribution view a flight dump gets.
+
+``--list-detectors`` prints the declared detector catalogue and exits
+(jax-free; CI uses it as the import-and-dry-run pin).
+
+Usage::
+
+    python tools/incident_report.py /tmp/lighthouse_tpu_incidents/<bundle>.json
+    python tools/incident_report.py --latest [--dir DIR]   # newest bundle
+    python tools/incident_report.py --list-detectors
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+sys.path.insert(0, _HERE)  # sibling tools resolve under `import tools.X` too
+
+# the producer owns the schema: a version bump there must fail loudly
+# here, not drift against a second literal
+from lighthouse_tpu.utils.watchtower import (  # noqa: E402
+    BUNDLE_PREFIX,
+    SCHEMA,
+    catalogue,
+)
+
+import forensics_report  # noqa: E402  (sibling tool: flight-tail renderer)
+
+
+def load(path: str) -> dict:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except json.JSONDecodeError as e:
+        raise ValueError(
+            f"{path}: line {e.lineno} col {e.colno}: not valid JSON: {e.msg}"
+        ) from None
+    schema = doc.get("schema")
+    if schema != SCHEMA:
+        raise ValueError(
+            f"{path}: field 'schema': unsupported incident bundle schema "
+            f"{schema!r} (this build reads {SCHEMA!r})"
+        )
+    return doc
+
+
+def _fields_inline(fields: dict, skip=()) -> str:
+    return " ".join(f"{k}={v}" for k, v in fields.items() if k not in skip)
+
+
+def _fmt(v) -> str:
+    if isinstance(v, float):
+        return f"{v:.4g}"
+    return str(v)
+
+
+def render_dials(doc: dict) -> list[str]:
+    """Per-family window stats from the bundle's timeseries block."""
+    ts = doc.get("timeseries") or {}
+    fams = ts.get("families") or {}
+    det_fam = None
+    source = (doc.get("detector") or {}).get("source", "")
+    if source.startswith("series:"):
+        det_fam = source.partition(":")[2]
+    out = [
+        f"dials (captured window {_fmt(ts.get('window_s'))}s, "
+        f"margin {_fmt(doc.get('margin_s'))}s):"
+    ]
+    for fam in sorted(fams):
+        for label in sorted(fams[fam]):
+            pts = fams[fam][label]
+            name = fam + (f"{{{label}}}" if label else "")
+            mark = "  <-- tripped" if fam == det_fam else ""
+            if not pts:
+                out.append(f"  {name:<44s} (no points){mark}")
+                continue
+            vals = [p[1] for p in pts]
+            out.append(
+                f"  {name:<44s} n={len(pts):<4d} "
+                f"first={_fmt(vals[0]):>8s} min={_fmt(min(vals)):>8s} "
+                f"max={_fmt(max(vals)):>8s} last={_fmt(vals[-1]):>8s}{mark}"
+            )
+    if len(out) == 1:
+        out.append("  (no timeseries captured)")
+    return out
+
+
+def render_slot_cards(doc: dict) -> list[str]:
+    cards = doc.get("slot_cards") or []
+    if not cards:
+        return ["slot report cards: (none)"]
+    out = ["slot report cards (oldest first):",
+           "  slot     epoch  sets   misses  p99_ms    headroom_min"]
+    for c in cards:
+        p99 = c.get("p99_ms")
+        hr = c.get("headroom_min")
+        out.append(
+            f"  {c.get('slot', '?'):<8} {c.get('epoch', '?'):<6} "
+            f"{c.get('sets', 0):<6d} {c.get('misses', 0):<7d} "
+            f"{_fmt(p99) if p99 is not None else '-':<9s} "
+            f"{_fmt(hr) if hr is not None else '-'}"
+        )
+    return out
+
+
+def render(doc: dict) -> str:
+    inc = doc.get("incident") or {}
+    det = doc.get("detector") or {}
+    state = "RESOLVED" if inc.get("resolved_t") is not None else "OPEN"
+    out = [
+        f"incident bundle — {inc.get('id')} {inc.get('detector')} "
+        f"severity={inc.get('severity')} [{state}]",
+        f"  opened_at={inc.get('opened_at')} "
+        f"resolved_at={inc.get('resolved_at', '-') or '-'} "
+        f"duration={_fmt(inc.get('duration_s', 0.0))}s "
+        f"flaps={inc.get('flaps', 0)} label={inc.get('label') or '-'}",
+        f"  value={_fmt(inc.get('value'))} "
+        f"last_value={_fmt(inc.get('last_value'))} "
+        f"threshold={_fmt(inc.get('threshold'))}",
+        f"  detector: {det.get('algo')} on {det.get('source')} "
+        f"window={_fmt(det.get('window_s'))}s "
+        f"threshold={_fmt(det.get('threshold'))} "
+        f"clear={_fmt(det.get('clear'))} sustain={det.get('sustain')} "
+        f"direction={det.get('direction')}",
+        f"  doc: {det.get('doc')}",
+    ]
+    trig = inc.get("trigger") or {}
+    if trig:
+        out.append(f"  trigger: {_fields_inline(trig)}")
+    out.append("")
+    out.extend(render_dials(doc))
+    out.append("")
+    out.extend(render_slot_cards(doc))
+    ct = doc.get("chain_time") or {}
+    if ct:
+        out.append("")
+        out.append("chain time: " + _fields_inline(ct, skip=("lifetime",)))
+    cap = doc.get("capacity") or {}
+    est = (cap.get("estimate") or {}) if isinstance(cap, dict) else {}
+    if est:
+        out.append("capacity estimate: " + _fields_inline(est))
+    prof = doc.get("profiler") or {}
+    fl = prof.get("flushes") or {}
+    if fl.get("count"):
+        out.append(
+            "profiler: "
+            + _fields_inline({k: _fmt(v) for k, v in fl.items()})
+        )
+    health = doc.get("health")
+    out.append(
+        "health snapshot: "
+        + ("embedded (keys: " + ", ".join(sorted(health)) + ")"
+           if isinstance(health, dict) else "(not captured)")
+    )
+    fr = doc.get("flight_recorder") or {}
+    out.append("")
+    if fr.get("events"):
+        out.append("flight-recorder tail:")
+        out.append(forensics_report.render(fr))
+    else:
+        out.append("flight-recorder tail: (no events captured)")
+    return "\n".join(out)
+
+
+def render_catalogue() -> str:
+    out = ["declared detector catalogue:",
+           f"  {'name':<32s} {'algo':<7s} {'severity':<8s} "
+           f"{'window_s':<9s} {'threshold':<10s} source"]
+    for d in catalogue():
+        out.append(
+            f"  {d['name']:<32s} {d['algo']:<7s} {d['severity']:<8s} "
+            f"{_fmt(d['window_s']):<9s} {_fmt(d['threshold']):<10s} "
+            f"{d['source']}"
+        )
+        out.append(f"    {d['doc']}")
+    return "\n".join(out)
+
+
+def latest_bundle(directory: str | None = None) -> str:
+    """Newest bundle in ``directory`` (default: the watchtower's
+    configured bundle dir). Names embed a ms timestamp, so lexicographic
+    max is the newest."""
+    from lighthouse_tpu.utils import watchtower
+
+    directory = directory or watchtower.bundle_dir()
+    names = sorted(
+        n for n in os.listdir(directory)
+        if n.startswith(BUNDLE_PREFIX) and n.endswith(".json")
+    )
+    if not names:
+        raise FileNotFoundError(f"no incident bundles in {directory}")
+    return os.path.join(directory, names[-1])
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("bundle", nargs="?", help="incident bundle JSON path")
+    ap.add_argument("--latest", action="store_true",
+                    help="render the newest bundle in --dir")
+    ap.add_argument("--dir", default=None,
+                    help="bundle directory for --latest")
+    ap.add_argument("--list-detectors", action="store_true",
+                    help="print the declared detector catalogue and exit")
+    args = ap.parse_args(argv)
+    if args.list_detectors:
+        print(render_catalogue())
+        return
+    if args.latest:
+        path = latest_bundle(args.dir)
+    elif args.bundle:
+        path = args.bundle
+    else:
+        ap.error("give a bundle path, --latest, or --list-detectors")
+    print(render(load(path)))
+
+
+if __name__ == "__main__":
+    main()

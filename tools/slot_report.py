@@ -10,8 +10,11 @@ The same live/model split as ``tools/capacity_report.py``:
     python tools/slot_report.py --url ... --view epochs --last 8
 
     # saved report: re-render the slot-aligned section of a
-    # tools/traffic_replay.py report (timed or lockstep mode)
+    # tools/traffic_replay.py report (timed or lockstep mode) — or of a
+    # watchtower incident bundle's captured slot cards
     python tools/slot_report.py --replay /tmp/flood_report.json
+    python tools/slot_report.py --replay \\
+        /tmp/lighthouse_tpu_incidents/lighthouse_tpu_incident_<id>.json
 
     # jax-free model: lockstep-replay a generated trace and score its
     # slots (the canonical epoch-boundary demo)
@@ -89,8 +92,24 @@ def _norm_lockstep_row(row: dict) -> dict:
 
 
 def normalize(doc: dict) -> dict:
-    """A traffic_replay report (timed or lockstep), or a
-    ``/lighthouse/slots`` document, → the scoreboard shape."""
+    """A traffic_replay report (timed or lockstep), a
+    ``/lighthouse/slots`` document, or a watchtower incident bundle, →
+    the scoreboard shape."""
+    schema = doc.get("schema")
+    if isinstance(schema, str) and schema.startswith("lighthouse_tpu.incident/"):
+        from lighthouse_tpu.utils.watchtower import SCHEMA as INCIDENT_SCHEMA
+
+        if schema != INCIDENT_SCHEMA:
+            raise SystemExit(
+                f"field 'schema': unsupported incident bundle schema "
+                f"{schema!r} (this build reads {INCIDENT_SCHEMA!r})"
+            )
+        return {
+            "source": "incident",
+            "chain_time": doc.get("chain_time"),
+            "slots": [_norm_ledger_card(c) for c in doc.get("slot_cards", [])],
+            "epochs": [],
+        }
     if "rows" in doc and "view" in doc:  # /lighthouse/slots document
         rows = doc["rows"]
         if doc["view"] == "epochs":
@@ -136,7 +155,8 @@ def normalize(doc: dict) -> dict:
         }
     raise SystemExit(
         "unrecognized document: want a traffic_replay report "
-        "(mode timed|lockstep) or a /lighthouse/slots reply"
+        "(mode timed|lockstep), a /lighthouse/slots reply, or a "
+        "watchtower incident bundle"
     )
 
 
@@ -231,8 +251,14 @@ def main(argv=None) -> int:
             q.append(f"last={args.last}")
         doc = fetch_json(base + "/lighthouse/slots?" + "&".join(q))
     elif args.replay:
-        with open(args.replay) as f:
-            doc = json.load(f)
+        try:
+            with open(args.replay) as f:
+                doc = json.load(f)
+        except json.JSONDecodeError as e:
+            raise SystemExit(
+                f"{args.replay}: line {e.lineno} col {e.colno}: "
+                f"not valid JSON: {e.msg}"
+            )
     else:
         from lighthouse_tpu.verification_service import traffic
 

@@ -37,6 +37,9 @@ deadline-miss the acceptance gate looks for. ``--compile-service stub``
 attaches a real ``CompileService`` with an injected compile function
 (``--stub-compile-s`` per rung), so early flushes shed to the fallback
 path and later ones run "warm" — the full routing surface without XLA.
+``--watchtower`` (timed mode) arms the anomaly watchtower for the run
+and reports measured DETECTION LEAD TIME: first incident open vs the
+first deadline-miss burst (see ``docs/OBSERVABILITY.md``).
 """
 
 from __future__ import annotations
@@ -200,6 +203,54 @@ def recovery_timeline(shard: int, since_wall_t: float) -> dict | None:
             round(post_sets / post_wall, 2) if post_wall > 0 else None
         )
     return out
+
+
+def detection_lead(since_wall_t: float, burst_n: int = 5,
+                   burst_window_s: float = 1.0) -> dict:
+    """Measured detection lead time (ISSUE 18): how far the watchtower's
+    first latched incident preceded the first deadline-miss BURST. A
+    burst is >= ``burst_n`` journaled ``deadline_miss`` events inside
+    ``burst_window_s`` wall seconds — an isolated miss (one bulk
+    backfill flush blowing its budget every few seconds) is steady-state
+    noise, not the onset the headroom dial has to beat. Positive
+    ``lead_time_s`` means the incident opened BEFORE the misses
+    clustered — the page fired while there was still time to shed."""
+    from lighthouse_tpu.utils import flight_recorder as fr
+    from lighthouse_tpu.utils import watchtower
+
+    incs = [
+        i for i in watchtower.incidents() if i["opened_t"] >= since_wall_t
+    ]
+    first_inc = min((i["opened_t"] for i in incs), default=None)
+    misses = sorted(
+        e["t"] for e in fr.events(["deadline_miss"])
+        if e["t"] >= since_wall_t
+    )
+    burst_t = None
+    for i in range(len(misses) - burst_n + 1):
+        if misses[i + burst_n - 1] - misses[i] <= burst_window_s:
+            burst_t = misses[i]
+            break
+    return {
+        "n_incidents": len(incs),
+        "first_incident_t": (
+            None if first_inc is None else round(first_inc - since_wall_t, 3)
+        ),
+        "first_incident_detector": next(
+            (i["detector"] for i in incs if i["opened_t"] == first_inc), None
+        ),
+        "miss_events": len(misses),
+        "burst_n": burst_n,
+        "burst_window_s": burst_window_s,
+        "first_miss_burst_t": (
+            None if burst_t is None else round(burst_t - since_wall_t, 3)
+        ),
+        "lead_time_s": (
+            round(burst_t - first_inc, 3)
+            if burst_t is not None and first_inc is not None
+            else None
+        ),
+    }
 
 
 def make_crypto_set_factory():
@@ -637,6 +688,25 @@ def _print_human(header, report):
                 f"  recovery: shard {rec['shard']} lost, NOT recovered "
                 f"({rec['probes']} probes)"
             )
+    wt = report.get("watchtower")
+    if wt:
+        lead = wt["lead"]
+        n_open = sum(1 for i in wt["incidents"] if i["resolved_t"] is None)
+        print(
+            f"  watchtower: {lead['n_incidents']} incident(s), {n_open} open; "
+            f"first incident "
+            f"{lead['first_incident_detector'] or '-'}"
+            f"@{lead['first_incident_t']}s, "
+            f"miss burst (>={lead['burst_n']} in {lead['burst_window_s']}s)"
+            f"@{lead['first_miss_burst_t']}s, "
+            f"detection lead {lead['lead_time_s']}s"
+        )
+        for inc in wt["incidents"]:
+            print(
+                f"    [{inc['severity']:<4}] {inc['id']} {inc['detector']} "
+                f"value={inc['value']} threshold={inc['threshold']} "
+                f"flaps={inc['flaps']} bundle={inc['bundle_path']}"
+            )
     print(f"  {'kind':<18}{'count':>7}{'p50_ms':>9}{'p99_ms':>9}"
           f"{'miss%':>7}  paths")
     for kind, rec in slo["kinds"].items():
@@ -765,6 +835,22 @@ def main(argv=None) -> int:
         "resolves on the `fused` path)",
     )
     run.add_argument(
+        "--watchtower", action="store_true",
+        help="arm the watchtower (ISSUE 18) for the replay: a fast "
+        "capacity sampler + detector evaluator run alongside the "
+        "scheduler, incidents latch correlated bundles, and the report "
+        "gains measured DETECTION LEAD TIME — first incident open vs "
+        "the first deadline-miss burst (timed mode only)",
+    )
+    run.add_argument(
+        "--watchtower-sample-s", type=float, default=0.25,
+        help="capacity sampler period while --watchtower is armed",
+    )
+    run.add_argument(
+        "--watchtower-eval-s", type=float, default=0.1,
+        help="watchtower evaluator period while --watchtower is armed",
+    )
+    run.add_argument(
         "--slot-s", type=float, default=2.0,
         help="trace seconds per chain slot for slot-aligned attribution "
         "(both modes; the canonical generators emit 2 s slots)",
@@ -799,6 +885,9 @@ def main(argv=None) -> int:
         print(f"wrote trace: {args.write_trace}", file=sys.stderr)
     if not events:
         raise SystemExit("trace has no events")
+
+    if args.watchtower and args.mode != "timed":
+        raise SystemExit("--watchtower requires --mode timed")
 
     if args.mode == "trace":
         if not args.write_trace:
@@ -895,6 +984,33 @@ def main(argv=None) -> int:
                 probe_fn=make_probe(verify_fn, set_factory),
                 base_backoff_s=args.probe_base_s,
             )
+        wt_report = None
+        wt_prev = ts_prev = None
+        if args.watchtower:
+            import tempfile
+
+            from lighthouse_tpu.utils import timeseries, watchtower
+
+            # replay-scoped watchtower: fresh store + fresh incident
+            # ledger, a sampler/evaluator fast enough to catch a ramp
+            # inside a seconds-long trace, bundles parked in their own
+            # directory (inspect with tools/incident_report.py --latest
+            # --dir <dir>)
+            timeseries.reset()
+            ts_prev = timeseries.configure(
+                enabled=True, interval_s=args.watchtower_sample_s
+            )
+            watchtower.reset()
+            wt_prev = watchtower.configure(
+                enabled=True,
+                interval_s=args.watchtower_eval_s,
+                cooldown_s=5.0,
+                bundle_dir=tempfile.mkdtemp(
+                    prefix="lighthouse_tpu_incidents_replay_"
+                ),
+            )
+            timeseries.start_sampler(args.watchtower_sample_s)
+            watchtower.start_evaluator(args.watchtower_eval_s)
         t_wall_start = time.time()
         try:
             report = run_timed_replay(
@@ -912,6 +1028,24 @@ def main(argv=None) -> int:
                 slots_per_epoch=args.slots_per_epoch,
             )
         finally:
+            if args.watchtower:
+                from lighthouse_tpu.utils import timeseries, watchtower
+
+                # one last sample + evaluation so a breach still rising
+                # at the trace's tail latches before harvest
+                timeseries.stop_sampler()
+                timeseries.sample()
+                watchtower.stop_evaluator()
+                watchtower.evaluate()
+                wt_report = {
+                    "sample_s": args.watchtower_sample_s,
+                    "eval_s": args.watchtower_eval_s,
+                    "lead": detection_lead(t_wall_start),
+                    "incidents": watchtower.incidents(),
+                    "summary": watchtower.summary(),
+                }
+                watchtower.configure(**wt_prev)
+                timeseries.configure(**ts_prev)
             if dmesh is not None:
                 from lighthouse_tpu.crypto.device import mesh as mesh_mod
 
@@ -926,6 +1060,7 @@ def main(argv=None) -> int:
                 report_fault = None
         report["mesh"] = None if dmesh is None else dmesh.status()
         report["fault_injection"] = report_fault
+        report["watchtower"] = wt_report
         if args.kill_shard is not None:
             report["recovery"] = recovery_timeline(
                 args.kill_shard, t_wall_start
