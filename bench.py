@@ -906,6 +906,114 @@ def measure_epoch_flood_leg(
     }
 
 
+def measure_lookahead_leg(
+    use_cpu: bool,
+    seed: int = 7,
+    duration_s: float = 12.0,
+    time_scale: float = 0.25,
+    deadline_ms: float = 50.0,
+    slot_s: float = 2.0,
+) -> dict:
+    """Duty-lookahead leg (ISSUE 19): the canonical epoch-boundary
+    flood replayed twice — reactive-only vs ``--lookahead`` (the
+    duty-lookahead warm pre-seeding each epoch's committees before
+    their first signature). Scores the first-sighting hit ratio pair
+    (acceptance: ~0.8 off, 1.0 on with ZERO first sightings), the
+    flood-slot p99 on each side, and the warm's attribution (committees
+    warmed, host vs device sums — the replay is stub-backend, so sums
+    are virtual and host_sums must stay 0 inside verify spans either
+    way). Two stub subprocesses (seconds); headline numbers LEARNED by
+    ``tools/bench_diff.py``."""
+    replay = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools",
+        "traffic_replay.py",
+    )
+    env = dict(os.environ)
+    if use_cpu:
+        env["JAX_PLATFORMS"] = "cpu"
+
+    def _run(lookahead: bool) -> dict:
+        leg_timeout = min(120.0, _budget_left() - 60)
+        if leg_timeout < 30:
+            return {"skipped": "budget"}
+        cmd = [sys.executable, replay,
+               "--generate", "epoch_boundary_flood", "--seed", str(seed),
+               "--duration", str(duration_s),
+               "--time-scale", str(time_scale),
+               "--deadline-ms", str(deadline_ms),
+               "--slot-s", str(slot_s),
+               "--verify", "stub:0.0005", "--json"]
+        if lookahead:
+            cmd.append("--lookahead")
+        try:
+            r = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=leg_timeout,
+                env=env,
+            )
+        except subprocess.TimeoutExpired:
+            return {"skipped": f"timeout>{leg_timeout:.0f}s"}
+        if r.returncode != 0:
+            return {"error": f"rc={r.returncode}: {r.stderr[-200:]}"}
+        try:
+            return json.loads(r.stdout.strip().splitlines()[-1])
+        except (ValueError, IndexError):
+            return {"error": f"unparseable output: {r.stdout[-200:]}"}
+
+    def _side(report: dict) -> dict:
+        if "skipped" in report or "error" in report:
+            return report
+        ct = report.get("chain_time", {})
+        slots = [s for s in report.get("slots", []) if s["sets"]]
+        counts = sorted(s["sets"] for s in slots)
+        median_sets = counts[len(counts) // 2] if counts else 0
+        flood = [s for s in slots if s["sets"] > 2 * median_sets]
+        lifetime = ct.get("lifetime", {})
+        side = {
+            "first_sighting_hit_ratio": ct.get("first_sighting_hit_ratio"),
+            "first_sightings": ct.get("first_sightings"),
+            "sighting_hits": ct.get("sighting_hits"),
+            "flood_p99_ms": (
+                round(max(s["p99_ms"] for s in flood), 3)
+                if flood and all(
+                    s["p99_ms"] is not None for s in flood
+                ) else None
+            ),
+            "verdicts": report.get("verdicts"),
+            "lookahead_host_sums": lifetime.get("lookahead_host_sums", 0),
+        }
+        la = ct.get("lookahead")
+        if la:
+            side["epochs_warmed"] = la.get("epochs_warmed")
+            side["committees_warmed"] = la.get("committees")
+            side["prewarmed"] = la.get("prewarmed")
+        return side
+
+    off = _side(_run(lookahead=False))
+    on = _side(_run(lookahead=True))
+    out = {
+        "generator": "epoch_boundary_flood",
+        "seed": seed,
+        "slot_s": slot_s,
+        "time_scale": time_scale,
+        "off": off,
+        "on": on,
+    }
+    r_off = off.get("first_sighting_hit_ratio")
+    r_on = on.get("first_sighting_hit_ratio")
+    if r_off is not None and r_on is not None:
+        out["hit_ratio_gain"] = round(r_on - r_off, 4)
+        # the acceptance pair at a glance: on-side reaches unity with
+        # zero first sightings, and neither side pays host EC sums in
+        # verify spans (warm sums are attributed off-path)
+        out["on_reaches_unity"] = bool(
+            r_on >= 1.0 and on.get("first_sightings") == 0
+        )
+        out["verdicts_identical"] = bool(
+            off.get("verdicts") == on.get("verdicts")
+        )
+    return out
+
+
 def measure_chaos_leg(
     use_cpu: bool,
     generator: str = "gossip_steady",
@@ -1678,6 +1786,18 @@ def main() -> None:
         except Exception as e:  # the leg must not kill the line
             epoch_flood_leg = {"error": str(e)[:200]}
 
+    # Duty-lookahead leg (ISSUE 19): the canonical flood replayed
+    # reactive-only vs --lookahead — the first-sighting hit-ratio pair
+    # (~0.8 -> 1.0 with zero firsts), flood p99 on each side, verdict
+    # identity. Two stub subprocesses, seconds; learned by bench_diff.
+    if _budget_left() < 120:
+        lookahead_leg = {"skipped": "budget"}
+    else:
+        try:
+            lookahead_leg = measure_lookahead_leg(use_cpu)
+        except Exception as e:  # the leg must not kill the line
+            lookahead_leg = {"error": str(e)[:200]}
+
     # Watchtower leg (ISSUE 18): the acceptance saturation ramp with
     # the anomaly evaluator off vs on — evaluator overhead (flagged
     # against the <1% budget) and the measured detection lead of the
@@ -1793,6 +1913,7 @@ def main() -> None:
                 "chaos_leg": chaos_leg,
                 "bulk_leg": bulk_leg,
                 "epoch_flood_leg": epoch_flood_leg,
+                "lookahead_leg": lookahead_leg,
                 "watchtower_leg": watchtower_leg,
                 "dp_leg": dp_leg,
                 "startup": startup,

@@ -109,6 +109,17 @@ class ClientConfig:
     # and byte-identical — this only buys the batched-sum speedup. Only
     # effective with bls_backend="tpu".
     device_msm: bool = False
+    # duty-lookahead precompute (ISSUE 19): a builder-owned background
+    # worker that, past the trigger point inside each epoch, walks the
+    # NEXT epoch's committee shuffle and pre-inserts every committee's
+    # aggregate-sum G1 row into the key table's (epoch-tagged)
+    # aggregate region — so a committee's FIRST sighting already ships
+    # K=1 with zero host EC adds inside any verify span. None = env
+    # LIGHTHOUSE_TPU_DUTY_LOOKAHEAD (default on); trigger/poll/backoff
+    # knobs stay env-tunable (LIGHTHOUSE_TPU_DUTY_LOOKAHEAD_*,
+    # docs/DUTY_LOOKAHEAD.md). Only effective when the device key
+    # table came up — without a table there is nothing to pre-insert.
+    duty_lookahead: Optional[bool] = None
 
 
 class Client:
@@ -177,6 +188,12 @@ class Client:
 
                 csvc.stop()
                 clear_service(csvc)
+            lookahead = getattr(self.chain, "duty_lookahead", None)
+            if lookahead is not None:
+                # before the key table closes: an in-flight warm may
+                # still be pre-inserting rows into it (bounded join —
+                # stop() during a warm must never wedge)
+                lookahead.stop()
             ktable = getattr(self.chain, "device_key_table", None)
             if ktable is not None:
                 # after the drain too: a draining flush may still pack
@@ -514,6 +531,24 @@ class ClientBuilder:
                     )
                     ktable = None
         chain.device_key_table = ktable
+
+        lookahead = None
+        if ktable is not None:
+            # duty-lookahead precompute (ISSUE 19): only with a live key
+            # table — the worker exists to pre-insert aggregate rows
+            from . import duty_lookahead as _lookahead
+
+            want = (
+                _lookahead.enabled()
+                if cfg.duty_lookahead is None else cfg.duty_lookahead
+            )
+            if want:
+                lookahead = _lookahead.DutyLookahead(
+                    _lookahead.chain_duty_source(chain),
+                    key_table=ktable,
+                    pubkey_cache=chain.pubkey_cache,
+                ).start()
+        chain.duty_lookahead = lookahead
 
         csvc = None
         if cfg.bls_backend == "tpu" and cfg.compile_service:

@@ -35,8 +35,17 @@ backend:
   aggregate point, so a K-wide committee set ships one index and pays
   one K=1 gather lane. Sums are inserted on the SECOND sighting of a
   tuple (``agg_min_repeats``) so one-shot participation subsets never
-  pay the host point-add cost, and the bounded aggregate region resets
-  wholesale when full.
+  pay the host point-add cost. The region is EPOCH-TAGGED (ISSUE 19):
+  each entry carries the epoch it serves, entries are retained for two
+  epochs (committees reshuffle each epoch but late attestations for
+  the prior one still arrive) and evicted per-epoch onto a slot
+  free-list as the chain clock advances — the wholesale
+  reset-when-full recycle survives only as the last resort when the
+  region fills inside a single epoch. :meth:`insert_precomputed` is
+  the duty-lookahead entry (``duty_lookahead/``): a committee sum
+  computed off the hot path, inserted for a FUTURE epoch, bypassing
+  ``agg_min_repeats`` so the committee's first sighting already ships
+  K=1 — the reactive path's admission rules are untouched.
 
 The verdict is IDENTICAL by construction: the gathered rows are the
 same limb encodings the raw packer ships, and an aggregate row is the
@@ -63,7 +72,13 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ...utils import fault_injection, flight_recorder, metrics, slot_ledger
+from ...utils import (
+    fault_injection,
+    flight_recorder,
+    metrics,
+    slot_clock,
+    slot_ledger,
+)
 
 # limbs per field element; pinned == fp.NL by test (this module must not
 # import the device fp module, which pulls jax)
@@ -172,8 +187,10 @@ _AGG_EVENTS = metrics.counter_vec(
     "aggregate-sum cache LOOKUP events: hit (cached tuple found — warm "
     "routing may still ship it un-collapsed; sets_total{collapsed} is "
     "the shipping truth), miss (tuple not cached), insert (host sum "
-    "computed + row uploaded), reset (bounded region recycled "
-    "wholesale)",
+    "computed + row uploaded), precomputed (duty-lookahead pre-insert, "
+    "ISSUE 19), evict (entry dropped by two-epoch retention, slot "
+    "freed), reset (region recycled wholesale — the same-epoch-full "
+    "last resort)",
     ("event",),
 )
 
@@ -238,10 +255,21 @@ class DeviceKeyTable:
         # already-encoded index at a different committee's sum.
         self._agg_slots: Dict[bytes, Optional[int]] = {}  # None = never cache
         self._agg_seen: Dict[bytes, int] = {}
-        self._agg_next = 0
+        self._agg_next = 0                  # slot high-water mark
         self._agg_resets = 0
         self._agg_gen = 0
         self._agg_reset_pending = False
+        # epoch-tagged retention (ISSUE 19): each occupied entry carries
+        # the epoch it serves; entries older than two epochs are evicted
+        # onto the slot free-list at the chain clock's epoch roll (and
+        # on demand when the region fills), replacing the wholesale
+        # reset as the steady-state recycler
+        self._agg_epochs: Dict[bytes, int] = {}
+        self._agg_free: List[int] = []
+        self._agg_resident = 0
+        self._agg_epoch_seen: Optional[int] = None
+        self._agg_evictions = 0
+        self._agg_precomputed = 0
         # shadow counters for status() (the health endpoint should not
         # parse the exposition to describe the table)
         self._uploads = {"startup": 0, "delta": 0, "aggregate": 0}
@@ -610,12 +638,22 @@ class DeviceKeyTable:
                 self._sets["raw"] += n
                 _SETS.with_labels("raw").inc(n)
                 return None
+            # epoch-tagged retention (ISSUE 19): applied only HERE,
+            # before any slot of this batch is handed out, so every
+            # slot a batch encodes stays valid until its snapshot below.
+            # At an epoch roll, entries older than two epochs move to
+            # the free-list; the wholesale reset fires only when the
+            # region filled and eviction freed nothing (everything
+            # resident is still inside its retention window).
+            cur_epoch = slot_clock.get_clock().current_epoch()
+            if self._agg_epoch_seen != cur_epoch:
+                self._agg_epoch_seen = cur_epoch
+                self._evict_stale_locked(cur_epoch, journal=True)
             if self._agg_reset_pending:
-                # deferred recycle: applied only HERE, before any slot
-                # of this batch is handed out, so every slot a batch
-                # encodes stays valid until its snapshot below
-                self._reset_aggregates_locked(journal=True)
                 self._agg_reset_pending = False
+                if not self._agg_free:
+                    if not self._evict_stale_locked(cur_epoch, journal=True):
+                        self._reset_aggregates_locked(journal=True)
             resolved: List[List[int]] = []
             for _sig, pks, _msg in sets:
                 idxs = []
@@ -711,12 +749,20 @@ class DeviceKeyTable:
                     if slot is None:
                         continue
                     if slot < 0:
-                        if self._agg_next >= self.max_aggregates:
+                        if self._agg_free:
+                            # slots recycled by per-epoch eviction are
+                            # reused before the high-water mark grows
+                            slot = self._agg_free.pop()
+                        elif self._agg_next < self.max_aggregates:
+                            slot = self._agg_next
+                            self._agg_next += 1
+                        else:
                             # bounded region: recycle at the START of
-                            # the next batch (see ctor comment)
+                            # the next batch (see ctor comment) —
+                            # eviction first, wholesale reset only if
+                            # nothing is stale
                             self._agg_reset_pending = True
                             continue
-                        slot = self._agg_next
                         # the insert copies only the SMALL aggregate
                         # arrays (~max_agg rows each), never the
                         # validator table — and writes EVERY replica
@@ -730,8 +776,9 @@ class DeviceKeyTable:
                                 self._agg_dev[s], slot, row,
                                 device=self._device_of(s),
                             )
-                        self._agg_next = slot + 1
                         self._agg_slots[key] = slot
+                        self._agg_epochs[key] = cur_epoch
+                        self._agg_resident += 1
                         self._agg_inserts += 1
                         # counted PER REPLICA, like sync(): the row
                         # really crossed the boundary once per chip
@@ -743,7 +790,9 @@ class DeviceKeyTable:
                         _UPLOAD_BYTES.with_labels("aggregate").inc(
                             row_bytes
                         )
-                        _ENTRIES.with_labels("aggregates").set(self._agg_next)
+                        _ENTRIES.with_labels("aggregates").set(
+                            self._agg_resident
+                        )
                     # slot >= 0 here covers the raced-duplicate-insert
                     # case too: another thread cached the same tuple
                     # between our phases — reuse its row (for EVERY
@@ -802,21 +851,142 @@ class DeviceKeyTable:
             h.update(int(i).to_bytes(8, "little"))
         return h.digest()
 
+    def insert_precomputed(self, idxs, point, epoch: Optional[int] = None) -> str:
+        """Duty-lookahead entry (ISSUE 19): pre-insert the aggregate sum
+        ``point`` for validator-index tuple ``idxs``, computed OFF the
+        hot path, tagged for ``epoch`` (default: the clock's NEXT epoch
+        — the shuffle a lookahead walks is deterministic an epoch
+        ahead). Bypasses ``agg_min_repeats`` — a lookahead-sourced
+        committee's FIRST sighting already ships K=1 — while leaving the
+        reactive path's admission rules untouched. Never forces the
+        wholesale reset: when the region is full and per-epoch eviction
+        frees nothing, the pre-insert is declined (``"full"``) and the
+        reactive path keeps owning the recycle policy.
+
+        Returns an outcome string: ``inserted`` | ``exists`` (already
+        cached — the retention tag is extended through the target
+        epoch) | ``infinity`` (never cached, marked so the device
+        ``agg_inf_bad`` screen keeps owning the edge) | ``never_cache``
+        (previously marked infinity) | ``full`` | ``unsynced`` (no
+        device region yet) | ``disabled``. The caller journals failures
+        (``lookahead_insert_failed``) — this method stays jax-free
+        until a row is actually written."""
+        idxs = [int(i) for i in idxs]
+        if self.max_aggregates <= 0 or len(idxs) <= 1:
+            return "disabled"
+        key = self._agg_key(idxs)
+        if point is None or point.is_infinity():
+            with self._lock:
+                self._agg_slots[key] = None
+            return "infinity"
+        from . import curve
+
+        rows, inf = curve.pack_g1([point])
+        if inf.any():
+            return "infinity"
+        row = np.ascontiguousarray(rows, np.int32)
+        with self._lock:
+            if not self._agg_dev:
+                return "unsynced"
+            cur_epoch = slot_clock.get_clock().current_epoch()
+            tag = (cur_epoch + 1) if epoch is None else int(epoch)
+            existing = self._agg_slots.get(key, -1)
+            if existing is None:
+                return "never_cache"
+            if existing >= 0:
+                # the reactive path cached it first: keep that row but
+                # extend retention through the lookahead's target epoch
+                self._agg_epochs[key] = max(
+                    self._agg_epochs.get(key, tag), tag
+                )
+                return "exists"
+            if self._agg_free:
+                slot = self._agg_free.pop()
+            elif self._agg_next < self.max_aggregates:
+                slot = self._agg_next
+                self._agg_next += 1
+            else:
+                self._evict_stale_locked(cur_epoch, journal=True)
+                if not self._agg_free:
+                    return "full"
+                slot = self._agg_free.pop()
+            for s in list(self._agg_dev):
+                self._agg_dev[s] = self._write_rows(
+                    self._agg_dev[s], slot, row,
+                    device=self._device_of(s),
+                )
+            self._agg_slots[key] = slot
+            self._agg_epochs[key] = tag
+            self._agg_resident += 1
+            self._agg_precomputed += 1
+            row_bytes = G1_ROW_BYTES * max(1, len(self._agg_dev))
+            self._uploads["aggregate"] += row_bytes
+            resident = self._agg_resident
+        _AGG_EVENTS.with_labels("precomputed").inc()
+        _UPLOAD_BYTES.with_labels("aggregate").inc(row_bytes)
+        _ENTRIES.with_labels("aggregates").set(resident)
+        return "inserted"
+
+    def _evict_stale_locked(self, cur_epoch: int, journal: bool) -> int:
+        """Two-epoch retention (ISSUE 19): drop every entry whose epoch
+        tag is two or more epochs behind ``cur_epoch`` — its committee
+        reshuffled away and even straggler attestations for it are past
+        — returning the slots to the free-list for reuse. ``_agg_seen``
+        survives like the wholesale reset's contract; the generation
+        bump tells any batch that already took slots to ship K indices
+        instead of a recycled row. Returns entries evicted (0 = nothing
+        stale, no generation bump)."""
+        stale = [
+            k for k, e in self._agg_epochs.items() if e + 2 <= cur_epoch
+        ]
+        if not stale:
+            return 0
+        dropped_epochs = sorted({self._agg_epochs[k] for k in stale})
+        for k in stale:
+            slot = self._agg_slots.pop(k, None)
+            del self._agg_epochs[k]
+            if slot is not None and slot >= 0:
+                self._agg_free.append(slot)
+        freed = len(stale)
+        self._agg_resident = max(0, self._agg_resident - freed)
+        self._agg_evictions += freed
+        self._agg_gen += 1
+        _AGG_EVENTS.with_labels("evict").inc(freed)
+        _ENTRIES.with_labels("aggregates").set(self._agg_resident)
+        if journal:
+            flight_recorder.record(
+                "key_table_reset",
+                region="aggregates",
+                mode="evict_epochs",
+                dropped=freed,
+                epochs=",".join(str(e) for e in dropped_epochs),
+                retained=self._agg_resident,
+                current_epoch=cur_epoch,
+            )
+        return freed
+
     def _reset_aggregates_locked(self, journal: bool) -> None:
-        """Recycle the bounded aggregate region. ``_agg_seen`` survives
-        (it has its own cap) so an evicted hot tuple re-inserts on its
-        next sighting; the generation bump tells any batch that already
-        took slots to ship K indices instead of a recycled row."""
-        had = self._agg_next
+        """Recycle the bounded aggregate region WHOLESALE — since
+        ISSUE 19 only the last resort, when the region filled inside a
+        single epoch and per-epoch eviction freed nothing. ``_agg_seen``
+        survives (it has its own cap) so an evicted hot tuple re-inserts
+        on its next sighting; the generation bump tells any batch that
+        already took slots to ship K indices instead of a recycled
+        row."""
+        had = self._agg_resident
         self._agg_slots.clear()
+        self._agg_epochs.clear()
+        self._agg_free.clear()
         self._agg_next = 0
+        self._agg_resident = 0
         self._agg_resets += 1
         self._agg_gen += 1
         _AGG_EVENTS.with_labels("reset").inc()
         _ENTRIES.with_labels("aggregates").set(0)
         if journal:
             flight_recorder.record(
-                "key_table_reset", region="aggregates", dropped=had
+                "key_table_reset", region="aggregates", mode="wholesale",
+                dropped=had,
             )
 
     # -- accounting helpers ------------------------------------------------
@@ -879,11 +1049,17 @@ class DeviceKeyTable:
                 "validators_resident": self._n,
                 "host_cache_len": len(self.cache.pubkeys),
                 "validator_capacity": self._cap_v,
-                "aggregates_resident": self._agg_next,
+                "aggregates_resident": self._agg_resident,
                 "aggregate_capacity": self.max_aggregates,
                 "aggregate_resets": self._agg_resets,
                 "aggregate_hits": self._agg_hits,
                 "aggregate_inserts": self._agg_inserts,
+                "aggregate_precomputed": self._agg_precomputed,
+                "aggregate_evictions": self._agg_evictions,
+                "aggregate_free_slots": len(self._agg_free),
+                "aggregate_epochs": sorted(
+                    set(self._agg_epochs.values())
+                ),
                 "device_bytes": cap_total * G1_ROW_BYTES,
                 "upload_bytes": dict(self._uploads),
                 "sets": sets,

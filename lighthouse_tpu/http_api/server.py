@@ -168,6 +168,12 @@ def build_health_doc(chain) -> dict:
     # upload accounting (null when the node runs without one)
     ktable = getattr(chain, "device_key_table", None)
     doc["key_table"] = None if ktable is None else ktable.status()
+    # duty-lookahead precompute (ISSUE 19): worker state, warmed epoch,
+    # per-path committee counts, pre-insert outcomes and the
+    # failure/backoff posture (null when the node runs without the
+    # worker — no key table, or disabled by config/env)
+    lookahead = getattr(chain, "duty_lookahead", None)
+    doc["duty_lookahead"] = None if lookahead is None else lookahead.status()
     # served dp mesh (ISSUE 11): per-chip sets/s, shard health,
     # per-chip device memory and the aggregate throughput the dp axis
     # delivers (null when the node runs single-device)

@@ -62,6 +62,7 @@ from . import flight_recorder, metrics
 FAULT_POINTS = (
     "compile",          # compile_service/service.py, per AOT rung compile
     "device_put",       # crypto/device/bls.py, raw/indexed pack upload
+    "duty_lookahead",   # duty_lookahead/, per epoch warm attempt
     "key_table_sync",   # crypto/device/key_table.py, mirror sync
     "staged_dispatch",  # crypto/device/bls.py, per staged program dispatch
 )

@@ -72,6 +72,8 @@ EVENT_KINDS = (
     "key_table_reset",        # crypto/device/key_table.py, agg region recycle
     "key_table_sync",         # crypto/device/key_table.py, startup/delta rows
     "log",                    # utils/logging.py, warn/error/crit lines
+    "lookahead_epoch_warmed",  # duty_lookahead/, one per warmed epoch
+    "lookahead_insert_failed",  # duty_lookahead/, per failed pre-insert
     "op_pool_device_agg",     # operation_pool/device_agg.py, per device merge
     "peer_ban",               # network/peer_manager.py
     "peer_penalty",           # network/peer_manager.py

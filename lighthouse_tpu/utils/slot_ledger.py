@@ -69,6 +69,7 @@ EVENTS = (
     "fresh_compile",
     "h2d",
     "headroom",
+    "lookahead",
     "rejection",
     "resolution",
     "sighting",
@@ -128,6 +129,9 @@ _COUNTERS = (
     "bulk_parked_sets",
     "sightings_first",
     "sightings_hit",
+    "lookahead_committees",
+    "lookahead_host_sums",
+    "lookahead_device_sums",
 )
 
 
@@ -161,6 +165,9 @@ def _new_card(slot: int, epoch: int) -> dict:
         "bulk_parked_sets": 0,
         "sightings_first": 0,
         "sightings_hit": 0,
+        "lookahead_committees": 0,
+        "lookahead_host_sums": 0,
+        "lookahead_device_sums": 0,
         "headroom_min": None,
         "headroom_samples": 0,
         "_lat_ms": [],  # capped reservoir, exact until the cap
@@ -325,6 +332,34 @@ def note_bulk(
         _lifetime["bulk_admitted_sets"] += admitted_sets
         _lifetime["bulk_parked_sets"] += parked_sets
     _EVENTS_TOTAL.with_labels("bulk").inc()
+
+
+def note_lookahead(
+    committees: int = 0,
+    host_sums: int = 0,
+    device_sums: int = 0,
+    slot: Optional[int] = None,
+) -> None:
+    """Duty-lookahead precompute work attributed to the slot it ran in
+    (ISSUE 19) — committees warmed for a FUTURE epoch, split by the sum
+    path that produced each aggregate row (device MSM vs host EC fold).
+    The point of the attribution: precompute cost lands visibly in the
+    quiet mid-epoch slots that paid it, and stays OUT of the verify-span
+    accounting — an epoch row whose sightings are all hits while its
+    slots carry ``lookahead_committees`` is the zero-host-sums-in-verify
+    acceptance shape, pinned by the replay gate."""
+    if not _enabled:
+        return
+    s, e = _resolve(slot)
+    with _lock:
+        card = _card(s, e)
+        card["lookahead_committees"] += committees
+        card["lookahead_host_sums"] += host_sums
+        card["lookahead_device_sums"] += device_sums
+        _lifetime["lookahead_committees"] += committees
+        _lifetime["lookahead_host_sums"] += host_sums
+        _lifetime["lookahead_device_sums"] += device_sums
+    _EVENTS_TOTAL.with_labels("lookahead").inc()
 
 
 def note_committee_sighting(outcome: str, slot: Optional[int] = None) -> None:
@@ -534,6 +569,25 @@ class CommitteeSightingModel:
         self._seen: Dict[Tuple[int, ...], int] = {}
         self.first = 0
         self.hits = 0
+        self.prewarmed = 0
+
+    def prewarm(self, committees) -> int:
+        """Duty-lookahead admission (ISSUE 19): mark each committee
+        tuple as already satisfying the repeat threshold — the model
+        mirror of ``DeviceKeyTable.insert_precomputed``, which bypasses
+        ``agg_min_repeats`` for lookahead-sourced tuples. A prewarmed
+        tuple's FIRST observe is a hit (K=1 shipped, no host EC sum in
+        any verify span). Warming is not a sighting: nothing is noted to
+        the ledger here — the lookahead worker attributes its own work
+        via :func:`note_lookahead`. Returns tuples newly warmed."""
+        n = 0
+        for c in committees:
+            key = tuple(int(v) for v in c)
+            if self._seen.get(key, 0) < self.min_repeats:
+                self._seen[key] = self.min_repeats
+                n += 1
+        self.prewarmed += n
+        return n
 
     def observe(self, committee, slot: Optional[int] = None) -> str:
         key = tuple(int(v) for v in committee)

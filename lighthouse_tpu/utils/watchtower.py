@@ -146,12 +146,17 @@ DETECTORS: Tuple[DetectorSpec, ...] = (
             "recent baseline — overlap lost to serialized flushes",
     ),
     DetectorSpec(
-        "first_sighting_hit_regression", "zscore",
+        "first_sighting_hit_regression", "floor",
         "series:slot_first_sighting_hit_ratio",
-        window_s=900.0, threshold=4.0, direction="below", min_points=8,
-        min_delta=0.1, sustain=2, severity="warn",
-        doc="the per-epoch committee first-sighting hit ratio dropping "
-            "below its baseline — aggregate-cache collapse regressing",
+        window_s=900.0, threshold=0.9, clear=0.97, min_points=1,
+        sustain=2, severity="warn",
+        doc="the per-epoch committee first-sighting hit ratio crossing "
+            "below its floor — duty-lookahead (ISSUE 19) holds the "
+            "steady state at ~1.0, so a drop means epoch warms are "
+            "failing (or the aggregate cache is collapsing) and first "
+            "sightings are paying host EC sums again; the incident "
+            "bundle's health snapshot carries the duty_lookahead block "
+            "for direct attribution",
     ),
     DetectorSpec(
         "headroom_floor", "floor", "series:capacity_headroom_ratio",

@@ -563,6 +563,7 @@ def lockstep_replay(
     slot_s: float = 2.0,
     slots_per_epoch: int = 32,
     agg_min_repeats: int = 2,
+    lookahead: bool = False,
 ) -> dict:
     """Deterministic virtual replay: walk the trace in arrival order and
     apply the scheduler's EXACT drain/flush policy (deadline measured
@@ -591,7 +592,18 @@ def lockstep_replay(
     (host EC sum territory), every later one a collapsed ``hit``
     (``DEFAULT_AGG_MIN_REPEATS`` in crypto/device/key_table.py). The
     model is local and pure — the lockstep simulator never touches the
-    process-global slot ledger."""
+    process-global slot ledger.
+
+    Duty-lookahead (ISSUE 19): with ``lookahead=True`` the admission
+    model is prewarmed the way the duty-lookahead worker prewarms the
+    key table — every committee tuple's epoch shuffle is deterministic
+    an epoch ahead, so each tuple counts as already-admitted (its seen
+    count starts at ``agg_min_repeats``) BEFORE its first arrival.
+    First sightings collapse to hits and the report's ``chain_time``
+    gains a ``lookahead`` block (prewarmed tuple count, per-epoch
+    breakdown). With ``lookahead=False`` (the default) the report body
+    is byte-identical to earlier releases — the digest-determinism
+    property is preserved."""
     planner = planner or FlushPlanner()
     deadline_s = deadline_ms / 1000.0
     bulk_linger_s = bulk_linger_ms / 1000.0
@@ -599,6 +611,21 @@ def lockstep_replay(
     slots_per_epoch = max(1, int(slots_per_epoch))
     slots: Dict[int, dict] = {}
     committee_seen: Dict[tuple, int] = {}
+    lookahead_epochs: Dict[int, int] = {}
+    if lookahead:
+        # the worker's effect, replayed pure: every committee's epoch
+        # assignment is known ahead of its first signature, so each
+        # distinct tuple starts at the admission threshold (the
+        # pre-inserted aggregate row) — attributed to the epoch of its
+        # first arrival for the report
+        for ev in sorted(events, key=lambda e: e["t"]):
+            vals = ev.get("validators")
+            if vals and len(vals) > 1:
+                key = tuple(vals)
+                if key not in committee_seen:
+                    committee_seen[key] = agg_min_repeats
+                    e = int(ev["t"] // slot_s) // slots_per_epoch
+                    lookahead_epochs[e] = lookahead_epochs.get(e, 0) + 1
     t_end = 0.0
 
     def slot_row(t: float) -> dict:
@@ -799,6 +826,16 @@ def lockstep_replay(
         },
         "slots": [slots[s] for s in sorted(slots)],
     }
+    if lookahead:
+        # present ONLY when on: the lookahead-off body (and digest)
+        # stays byte-identical to earlier releases
+        body["chain_time"]["lookahead"] = {
+            "enabled": True,
+            "committees": sum(lookahead_epochs.values()),
+            "epochs": [
+                [e, lookahead_epochs[e]] for e in sorted(lookahead_epochs)
+            ],
+        }
     digest = hashlib.sha256(
         json.dumps(body, sort_keys=True).encode()
     ).hexdigest()

@@ -78,6 +78,17 @@ def _bench_doc(sets_per_sec, waste, wrapped=False, kt_bytes=45.0,
             "overhead_under_1pct": True,
             "n_incidents": 1,
         },
+        # ISSUE 19: the duty-lookahead leg's off/on hit-ratio pair is
+        # learned (never gated) — present so the diff rows render
+        "lookahead_leg": {
+            "off": {"first_sighting_hit_ratio": 0.82,
+                    "flood_p99_ms": 80.0},
+            "on": {"first_sighting_hit_ratio": 1.0,
+                   "flood_p99_ms": 84.0},
+            "hit_ratio_gain": 0.18,
+            "on_reaches_unity": True,
+            "verdicts_identical": True,
+        },
     }
     return {"n": 1, "rc": 0, "parsed": doc} if wrapped else doc
 

@@ -1,0 +1,529 @@
+"""Duty-lookahead precompute (ISSUE 19): the trigger policy + worker
+lifecycle (backoff probation, clean stop, fault-injection drive), the
+key table's epoch-tagged aggregate region (``insert_precomputed``
+outcome matrix, two-epoch retention, eviction-before-wholesale-reset),
+the end-to-end warm → first-sighting-ships-K=1 path, the health block,
+and the replay acceptance gates: ``epoch_boundary_flood`` with
+lookahead on reaches first-sighting hit-ratio 1.0 (vs ~0.82 off) with
+ZERO host EC sums inside any verify span and verdict identity
+preserved; the retuned ``first_sighting_hit_regression`` floor
+detector opens an incident whose bundle carries the ``duty_lookahead``
+health block."""
+
+from __future__ import annotations
+
+import json
+import time
+import types
+
+import pytest
+
+from lighthouse_tpu import duty_lookahead as dl
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.crypto.device import key_table as kt
+from lighthouse_tpu.utils import fault_injection
+from lighthouse_tpu.utils import flight_recorder as fr
+from lighthouse_tpu.utils import slot_clock, slot_ledger
+
+
+@pytest.fixture
+def manual_clock():
+    """A process-global ManualSlotClock (epoch 0, slot 0), restored."""
+    clock = slot_clock.ManualSlotClock(seconds_per_slot=12, slots_per_epoch=32)
+    prev = slot_clock.set_clock(clock)
+    try:
+        yield clock
+    finally:
+        slot_clock.set_clock(prev)
+
+
+@pytest.fixture
+def journal(tmp_path):
+    prev = fr.configure(
+        capacity=4096, enabled=True, dump=False, dump_dir=str(tmp_path)
+    )
+    fr.clear()
+    try:
+        yield fr
+    finally:
+        fr.configure(**prev)
+        fr.clear()
+
+
+def _store_cache(n, seed=4000):
+    """A REAL ValidatorPubkeyCache admitted from a fake state — the
+    lookahead resolves committee indices through ``.get(i).point``."""
+    from lighthouse_tpu.beacon_chain.pubkey_cache import ValidatorPubkeyCache
+
+    sks = [bls.SecretKey(seed + i) for i in range(n)]
+    state = types.SimpleNamespace(
+        validators=[
+            types.SimpleNamespace(pubkey=sk.public_key().serialize())
+            for sk in sks
+        ]
+    )
+    cache = ValidatorPubkeyCache()
+    cache.import_new_pubkeys(state)
+    return sks, cache
+
+
+def _committee_sets(sks, cache, committee, msg=b"\x19" * 32):
+    """One aggregate (sig, [points], msg) triple over ``committee``."""
+    from lighthouse_tpu.crypto.params import R
+
+    sk_sum = sum(sks[i].k for i in committee) % R
+    agg = bls.Signature.deserialize(bls.SecretKey(sk_sum).sign(msg).serialize())
+    return [(agg, [cache.pubkeys[i].point for i in committee], msg)]
+
+
+def _host_sum(cache, committee):
+    pts = [cache.pubkeys[i].point for i in committee]
+    agg = pts[0]
+    for p in pts[1:]:
+        agg = agg + p
+    return agg
+
+
+# ---------------------------------------------------------------------------
+# Trigger policy + worker lifecycle
+# ---------------------------------------------------------------------------
+
+
+def test_trigger_policy_waits_for_epoch_fraction(manual_clock):
+    warmed = []
+    w = dl.DutyLookahead(
+        lambda e: [(1, 2, 3)], trigger_frac=0.5,
+        on_warmed=lambda e, cs: warmed.append(e),
+    )
+    # early in epoch 0: before the trigger point, no warm
+    manual_clock.set_slot(3)
+    assert w.tick() is None
+    assert warmed == []
+    # past the midpoint: the NEXT epoch warms exactly once
+    manual_clock.set_slot(17)
+    out = w.tick()
+    assert out is not None and out["epoch"] == 1
+    assert warmed == [1]
+    assert w.tick() is None  # idempotent per target epoch
+    # the epoch rolls: the new next epoch warms (again past midpoint)
+    manual_clock.set_slot(32 + 20)
+    out = w.tick()
+    assert out is not None and out["epoch"] == 2
+    assert warmed == [1, 2]
+    st = w.status()
+    assert st["warmed_epoch"] == 2
+    assert st["epochs"]["warmed"] == 2
+    assert st["committees"]["virtual"] == 2  # no key table: virtual mode
+
+
+def test_worker_thread_warms_and_stops_cleanly(manual_clock):
+    manual_clock.set_slot(20)  # past the epoch-0 midpoint
+    warmed = []
+    w = dl.DutyLookahead(
+        lambda e: [(7, 8)], poll_s=0.02,
+        on_warmed=lambda e, cs: warmed.append(e),
+    ).start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while not warmed and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert warmed == [1], "background worker must warm the next epoch"
+        assert w.status()["running"] is True
+    finally:
+        w.stop()
+    assert w.status()["running"] is False
+    # stop() is idempotent and start/stop leave no stuck thread
+    w.stop()
+
+
+def test_warm_failure_backs_off_then_probes(manual_clock, journal):
+    """PR 13's probation shape: a failing warm arms capped-exponential
+    backoff (ticks inside the pause do nothing), the failure journals
+    ``lookahead_insert_failed``, and the first post-pause retry IS the
+    probe — success resets the failure counter."""
+    manual_clock.set_slot(20)
+    fault_injection.arm("duty_lookahead", nth=1)
+    try:
+        w = dl.DutyLookahead(
+            lambda e: [(1, 2)], backoff_base_s=30.0, backoff_max_s=60.0
+        )
+        assert w.tick() is None  # injected failure
+        st = w.status()
+        assert st["failures"] == 1
+        assert st["backoff_s"] > 0
+        assert st["epochs"]["error"] == 1
+        assert "InjectedFault" in st["last_error"]
+        evs = journal.events(["lookahead_insert_failed"])
+        assert evs and evs[-1]["fields"]["reason"] == "warm_error"
+        # inside the pause: the trigger condition holds but nothing runs
+        assert w.tick() is None
+        assert w.status()["epochs"]["error"] == 1
+        # pause expiry (forced): the retry probes and recovers
+        with w._lock:
+            w._backoff_until = 0.0
+        out = w.tick()
+        assert out is not None and out["epoch"] == 1
+        st = w.status()
+        assert st["failures"] == 0
+        assert st["backoff_s"] == 0.0
+        assert st["epochs"]["warmed"] == 1
+        evs = journal.events(["lookahead_epoch_warmed"])
+        assert evs and evs[-1]["fields"]["epoch"] == 1
+    finally:
+        fault_injection.clear()
+
+
+# ---------------------------------------------------------------------------
+# Key table: insert_precomputed outcome matrix + epoch retention
+# ---------------------------------------------------------------------------
+
+
+def test_insert_precomputed_outcomes_and_first_sighting_k1(manual_clock):
+    sks, cache = _store_cache(8)
+    t = kt.DeviceKeyTable(cache, agg_min_repeats=2)
+    assert t.sync(reason="startup") == 8
+    committee = [0, 1, 2, 3]
+
+    # before any sighting: the lookahead pre-inserts, bypassing
+    # agg_min_repeats
+    assert t.insert_precomputed(committee, _host_sum(cache, committee)) \
+        == "inserted"
+    st = t.status()
+    assert st["aggregates_resident"] == 1
+    assert st["aggregate_precomputed"] == 1
+    assert st["aggregate_inserts"] == 0  # reactive counter untouched
+    # the NEXT epoch is the default retention tag (warmed ahead)
+    assert st["aggregate_epochs"] == [1]
+
+    # FIRST sighting ships K=1 (collapsed), zero host EC adds in-span
+    resolved, _dev, agg_dev, collapsed = t.resolve_sets(
+        _committee_sets(sks, cache, committee)
+    )
+    assert collapsed == 1
+    assert len(resolved[0]) == 1, "first sighting must ship K=1"
+    assert agg_dev is not None
+    assert st["aggregate_hits"] == 0  # status() snapshot from before
+    assert t.status()["aggregate_hits"] == 1
+
+    # duplicate pre-insert: exists, retention extended through epoch 5
+    assert t.insert_precomputed(
+        committee, _host_sum(cache, committee), epoch=5
+    ) == "exists"
+    assert t.status()["aggregate_epochs"] == [5]
+
+    # singleton and disabled-region guards
+    assert t.insert_precomputed([3], _host_sum(cache, [0, 1])) == "disabled"
+    t0 = kt.DeviceKeyTable(cache, max_aggregates=0)
+    assert t0.insert_precomputed([0, 1], None) == "disabled"
+    # infinity sums are never cached; the mark is remembered
+    assert t.insert_precomputed([4, 5], None) == "infinity"
+    assert t.insert_precomputed(
+        [4, 5], _host_sum(cache, [4, 5])
+    ) == "never_cache"
+    # an unsynced table has no aggregate region to write
+    t2 = kt.DeviceKeyTable(cache)
+    assert t2.insert_precomputed(
+        committee, _host_sum(cache, committee)
+    ) == "unsynced"
+
+
+def test_two_epoch_retention_evicts_instead_of_wholesale_reset(
+    manual_clock, journal
+):
+    """Epoch-tagged aggregate region: entries older than two epochs
+    move to the free-list at the epoch roll (per-epoch eviction), the
+    freed slots are reused by later inserts, and the wholesale
+    reset-when-full counter stays ZERO throughout."""
+    sks, cache = _store_cache(8)
+    t = kt.DeviceKeyTable(cache, agg_min_repeats=1)
+    t.sync(reason="startup")
+    a, b, c = [0, 1], [2, 3], [4, 5]
+
+    # epoch 0: A pre-inserted for epoch 0 (explicit tag), B for epoch 1
+    assert t.insert_precomputed(a, _host_sum(cache, a), epoch=0) == "inserted"
+    assert t.insert_precomputed(b, _host_sum(cache, b), epoch=1) == "inserted"
+    assert t.status()["aggregates_resident"] == 2
+
+    # epoch 1: both inside the two-epoch window — nothing evicts
+    manual_clock.set_slot(32)
+    t.resolve_sets(_committee_sets(sks, cache, a))
+    st = t.status()
+    assert st["aggregates_resident"] == 2
+    assert st["aggregate_evictions"] == 0
+
+    # epoch 2: A's tag (0) is two epochs behind — evicted; B (1) stays
+    manual_clock.set_slot(64)
+    resolved, _, _, collapsed = t.resolve_sets(
+        _committee_sets(sks, cache, b)
+    )
+    assert collapsed == 1, "retained entry must still serve K=1"
+    st = t.status()
+    assert st["aggregates_resident"] == 1
+    assert st["aggregate_evictions"] == 1
+    assert st["aggregate_free_slots"] == 1
+    assert st["aggregate_epochs"] == [1]
+    assert st["aggregate_resets"] == 0, "eviction must replace the reset"
+    evs = journal.events(["key_table_reset"])
+    assert evs and evs[-1]["fields"]["mode"] == "evict_epochs"
+    assert evs[-1]["fields"]["dropped"] == 1
+
+    # the freed slot is REUSED (free-list before high-water growth)
+    assert t.insert_precomputed(c, _host_sum(cache, c), epoch=3) == "inserted"
+    st = t.status()
+    assert st["aggregates_resident"] == 2
+    assert st["aggregate_free_slots"] == 0
+    # evicted A re-inserts REACTIVELY on its next sighting (seen counts
+    # survive eviction, same contract as the wholesale reset): the
+    # sighting is a `first` (it pays the host sum), the re-insert
+    # commits in the same batch's second phase, so the position still
+    # ships collapsed — and the next one is a plain hit
+    inserts0 = t.status()["aggregate_inserts"]
+    hits0 = t.status()["aggregate_hits"]
+    _r1, _, _, c1 = t.resolve_sets(_committee_sets(sks, cache, a))
+    assert c1 == 1
+    assert t.status()["aggregate_inserts"] == inserts0 + 1
+    assert t.status()["aggregate_hits"] == hits0
+    _r2, _, _, c2 = t.resolve_sets(_committee_sets(sks, cache, a))
+    assert c2 == 1
+    assert t.status()["aggregate_hits"] == hits0 + 1
+    assert t.status()["aggregate_resets"] == 0
+
+
+def test_full_region_declines_precompute_without_reset(manual_clock):
+    """A full region with nothing stale declines the pre-insert
+    (``full``) — the lookahead must never force the wholesale reset the
+    reactive path owns."""
+    _sks, cache = _store_cache(8)
+    t = kt.DeviceKeyTable(cache, max_aggregates=1, agg_min_repeats=1)
+    t.sync(reason="startup")
+    assert t.insert_precomputed(
+        [0, 1], _host_sum(cache, [0, 1]), epoch=0
+    ) == "inserted"
+    assert t.insert_precomputed(
+        [2, 3], _host_sum(cache, [2, 3]), epoch=0
+    ) == "full"
+    st = t.status()
+    assert st["aggregate_resets"] == 0
+    assert st["aggregates_resident"] == 1
+    # two epochs later the stale entry is evictable: the same insert
+    # lands on the recycled slot
+    manual_clock.set_slot(64)
+    assert t.insert_precomputed(
+        [2, 3], _host_sum(cache, [2, 3]), epoch=2
+    ) == "inserted"
+    assert t.status()["aggregate_resets"] == 0
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: worker warm → key table → first sighting ships K=1
+# ---------------------------------------------------------------------------
+
+
+def test_warm_epoch_preinserts_into_key_table(manual_clock, journal):
+    sks, cache = _store_cache(8)
+    t = kt.DeviceKeyTable(cache)
+    t.sync(reason="startup")
+    committees = {1: [(0, 1, 2, 3), (4, 5, 6, 7)]}
+    prev_ledger = slot_ledger.configure(enabled=True)
+    slot_ledger.reset()
+    try:
+        w = dl.DutyLookahead(
+            lambda e: committees.get(e, []),
+            key_table=t, pubkey_cache=cache,
+            device_sum=False,  # host fold: deterministic, no MSM compile
+        )
+        manual_clock.set_slot(20)
+        out = w.tick()
+        assert out is not None and out["epoch"] == 1
+        assert out["counts"]["host"] == 2
+        assert out["inserts"] == {"inserted": 2}
+        st = t.status()
+        assert st["aggregate_precomputed"] == 2
+        assert st["aggregates_resident"] == 2
+        assert st["aggregate_epochs"] == [1]
+        # chain-time attribution landed OUTSIDE any verify span
+        led = slot_ledger.summary()["lifetime"]
+        assert led["lookahead_committees"] == 2
+        assert led["lookahead_host_sums"] == 2
+        assert led["lookahead_device_sums"] == 0
+        ev = journal.events(["lookahead_epoch_warmed"])[-1]["fields"]
+        assert ev["epoch"] == 1 and ev["host_sums"] == 2
+
+        # the acceptance shape: epoch 1 arrives, the FIRST sighting of
+        # each warmed committee ships K=1 with zero in-span host sums
+        manual_clock.set_slot(32)
+        for c in committees[1]:
+            resolved, _, _, collapsed = t.resolve_sets(
+                _committee_sets(sks, cache, list(c))
+            )
+            assert collapsed == 1 and len(resolved[0]) == 1
+        assert t.status()["aggregate_hits"] == 2
+    finally:
+        slot_ledger.configure(**prev_ledger)
+        slot_ledger.reset()
+
+
+def test_unresolvable_committee_counts_failed_and_journals(
+    manual_clock, journal
+):
+    _sks, cache = _store_cache(4)
+    t = kt.DeviceKeyTable(cache)
+    t.sync(reason="startup")
+    w = dl.DutyLookahead(
+        lambda e: [(0, 99)],  # index 99 beyond the cache
+        key_table=t, pubkey_cache=cache, device_sum=False,
+    )
+    out = w.warm_epoch(1)
+    assert out["counts"]["failed"] == 1
+    evs = journal.events(["lookahead_insert_failed"])
+    assert evs and evs[-1]["fields"]["reason"] == "unresolved"
+    assert t.status()["aggregates_resident"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Health block
+# ---------------------------------------------------------------------------
+
+
+def test_health_doc_carries_duty_lookahead_block():
+    from lighthouse_tpu.http_api import server
+
+    doc = server.build_health_doc(types.SimpleNamespace())
+    assert doc["duty_lookahead"] is None  # node without the worker
+    w = dl.DutyLookahead(lambda e: [])
+    chain = types.SimpleNamespace(duty_lookahead=w)
+    doc = server.build_health_doc(chain)
+    block = doc["duty_lookahead"]
+    assert block is not None
+    assert block["running"] is False
+    assert set(block) >= {
+        "warmed_epoch", "epochs", "committees", "inserts", "failures",
+        "backoff_s", "trigger_frac",
+    }
+    json.dumps(doc)  # the document must stay JSON-serializable
+
+
+# ---------------------------------------------------------------------------
+# Replay acceptance (satellite): epoch_boundary_flood, lookahead off/on
+# ---------------------------------------------------------------------------
+
+
+def test_lockstep_flood_lookahead_reaches_unity_hit_ratio():
+    from lighthouse_tpu.verification_service import traffic
+
+    events = traffic.epoch_boundary_flood(duration_s=12, seed=7)
+    off = traffic.lockstep_replay(events)
+    on = traffic.lockstep_replay(events, lookahead=True)
+
+    # baseline: the reactive cache pays first sightings on the flood's
+    # stable committee recurrence
+    assert off["chain_time"]["first_sightings"] > 0
+    assert off["chain_time"]["first_sighting_hit_ratio"] < 0.9
+    assert "lookahead" not in off["chain_time"]
+
+    # lookahead: EVERY sighting is a hit — zero host-EC-sum territory
+    assert on["chain_time"]["first_sightings"] == 0
+    assert on["chain_time"]["first_sighting_hit_ratio"] == 1.0
+    la = on["chain_time"]["lookahead"]
+    assert la["enabled"] is True
+    assert la["committees"] == 16  # the flood's stable 16 committees
+    assert la["committees"] == sum(n for _e, n in la["epochs"])
+
+    # verdict identity: the precompute must not change WHAT was
+    # verified or how it flushed — only who paid the EC sums
+    for k in ("submissions", "bypasses", "flushes", "set_totals", "bulk"):
+        assert on[k] == off[k], f"lookahead changed replay surface {k!r}"
+    assert on["chain_time"]["committee_sightings"] \
+        == off["chain_time"]["committee_sightings"]
+
+    # determinism: the lookahead-off digest is byte-stable vs a rerun
+    again = traffic.lockstep_replay(events)
+    assert again["digest"] == off["digest"]
+
+
+# ---------------------------------------------------------------------------
+# Watchtower detector path (satellite): floor breach → incident whose
+# bundle carries the duty_lookahead health block
+# ---------------------------------------------------------------------------
+
+
+def test_hit_ratio_floor_incident_bundle_has_lookahead_block(tmp_path):
+    from lighthouse_tpu.utils import timeseries, watchtower
+
+    prev_fr = fr.configure(
+        capacity=4096, enabled=True, dump=False, dump_dir=str(tmp_path)
+    )
+    fr.clear()
+    timeseries.reset()
+    prev_ts = timeseries.configure(enabled=True)
+    watchtower.reset()
+    prev = watchtower.configure(
+        enabled=True, bundle=True,
+        bundle_dir=str(tmp_path / "incidents"), bundle_retain=8,
+    )
+    worker = dl.DutyLookahead(lambda e: [(1, 2)])
+    worker.warm_epoch(3)
+    watchtower.set_health_provider(
+        lambda: {"duty_lookahead": worker.status()}
+    )
+    try:
+        store = timeseries.get_store()
+        t0 = time.time()
+        # the lookahead steady state: ratio pinned at 1.0 — armed, quiet
+        store.record("slot_first_sighting_hit_ratio", 1.0, t=t0, label="4")
+        r = watchtower.evaluate(now=t0)
+        assert not [
+            t for t in r["transitions"]
+            if t["detector"] == "first_sighting_hit_regression"
+        ]
+        # warms failing: firsts pay host sums again, ratio under the
+        # 0.9 floor for the sustain pair → exactly one incident opens
+        store.record(
+            "slot_first_sighting_hit_ratio", 0.5, t=t0 + 1, label="5"
+        )
+        watchtower.evaluate(now=t0 + 1)
+        store.record(
+            "slot_first_sighting_hit_ratio", 0.4, t=t0 + 2, label="5"
+        )
+        r = watchtower.evaluate(now=t0 + 2)
+        opened = [
+            t for t in r["transitions"]
+            if t["detector"] == "first_sighting_hit_regression"
+        ]
+        assert [t["action"] for t in opened] == ["open"]
+        (inc,) = [
+            i for i in watchtower.incidents()
+            if i["detector"] == "first_sighting_hit_regression"
+        ]
+        assert inc["severity"] == "warn"
+        # the forensic bundle's health snapshot carries the block the
+        # operator needs to attribute the drop to the worker
+        with open(inc["bundle_path"]) as f:
+            bundle = json.load(f)
+        block = bundle["health"]["duty_lookahead"]
+        assert block["warmed_epoch"] == 3
+        assert block["epochs"]["warmed"] == 1
+        # hysteresis: inside the band (0.9..0.97) the incident latches
+        store.record(
+            "slot_first_sighting_hit_ratio", 0.93, t=t0 + 3, label="5"
+        )
+        assert watchtower.evaluate(now=t0 + 3)["transitions"] == []
+        assert watchtower.incidents(open_only=True)
+        # back at the lookahead steady state: resolves above 0.97
+        # (same label — the floor detector's state is per label)
+        store.record(
+            "slot_first_sighting_hit_ratio", 1.0, t=t0 + 4, label="5"
+        )
+        r = watchtower.evaluate(now=t0 + 4)
+        assert [
+            t["action"] for t in r["transitions"]
+            if t["detector"] == "first_sighting_hit_regression"
+        ] == ["resolve"]
+    finally:
+        watchtower.set_health_provider(None)
+        watchtower.configure(**prev)
+        watchtower.reset()
+        timeseries.configure(**prev_ts)
+        timeseries.reset()
+        fr.configure(**prev_fr)
+        fr.clear()

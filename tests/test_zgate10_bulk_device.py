@@ -66,6 +66,10 @@ def test_zgate10_bulk_class_on_staged_device_pipeline(tmp_path):
         capacity=4096, enabled=True, dump=False, dump_dir=str(tmp_path),
     )
     fr.clear()
+    # the miss family is process-global and cumulative: earlier tests in
+    # the same process may have legitimately missed backfill deadlines,
+    # so this gate asserts on ITS OWN delta, not the absolute count
+    miss0 = _miss_count("backfill")
 
     class _NoLatch:
         # the gossip round's staged-compile wall (minutes on XLA:CPU)
@@ -131,7 +135,7 @@ def test_zgate10_bulk_class_on_staged_device_pipeline(tmp_path):
                 "fresh compile means the class left the ladder"
             )
             assert len(fr.events(["bulk_resume"])) == 1
-            assert _miss_count("backfill") == 0, (
+            assert _miss_count("backfill") - miss0 == 0, (
                 "a bulk verdict is deadline-insensitive by contract: "
                 "seconds of throttled wait must not read as a miss"
             )

@@ -35,6 +35,7 @@ KNOWN_PREFIXES = (
     "capacity_",  # timeseries sampler + headroom estimator (ISSUE 14)
     "compile_service_",
     "device_",  # device_memory_bytes (utils/transfer_ledger.py, ISSUE 8)
+    "duty_lookahead_",  # duty-lookahead precompute (duty_lookahead/, ISSUE 19)
     "fault_",  # fault-injection layer (utils/fault_injection.py, ISSUE 13)
     "flight_recorder_",
     "head_",
@@ -70,6 +71,7 @@ def _import_instrumented_modules():
     import lighthouse_tpu.crypto.device.bls  # noqa: F401
     import lighthouse_tpu.crypto.device.key_table  # noqa: F401
     import lighthouse_tpu.crypto.device.mesh  # noqa: F401
+    import lighthouse_tpu.duty_lookahead  # noqa: F401
     import lighthouse_tpu.http_api.server  # noqa: F401
     import lighthouse_tpu.utils.fault_injection  # noqa: F401
     import lighthouse_tpu.utils.flight_recorder  # noqa: F401
@@ -603,6 +605,57 @@ def test_slot_ledger_families_registered():
         with pytest.raises(ValueError):
             slot_ledger.note_committee_sighting("zgate4_undeclared")
     import tools.slot_report  # noqa: F401
+
+
+def test_duty_lookahead_families_registered():
+    """ISSUE 19 families (duty_lookahead/) exist under their declared
+    types + labels, the journal kinds are in the sorted catalogue, the
+    fault point is declared, the key table's slot-ledger seam carries
+    the lookahead counters, and the package stays importable jax-free
+    (subprocess-pinned: the replay driver imports it on boxes that
+    must not initialize a backend)."""
+    _import_instrumented_modules()
+    reg = metrics.registry_snapshot()
+    want = {
+        "duty_lookahead_epochs_total": ("counter", ("outcome",)),
+        "duty_lookahead_committees_total": ("counter", ("path",)),
+        "duty_lookahead_inserts_total": ("counter", ("outcome",)),
+        "duty_lookahead_warm_seconds": ("gauge", None),
+    }
+    for name, (kind, labels) in want.items():
+        m = reg.get(name)
+        assert m is not None, f"family {name} not registered"
+        assert m.kind == kind, (name, m.kind)
+        if labels is not None:
+            assert m.labelnames == labels, (name, m.labelnames)
+        else:
+            assert not hasattr(m, "labelnames"), name  # unlabeled family
+    from lighthouse_tpu.utils import fault_injection, flight_recorder
+    from lighthouse_tpu.utils import slot_ledger
+
+    assert "lookahead_epoch_warmed" in flight_recorder.EVENT_KINDS
+    assert "lookahead_insert_failed" in flight_recorder.EVENT_KINDS
+    assert "duty_lookahead" in fault_injection.FAULT_POINTS
+    assert "lookahead" in slot_ledger.EVENTS
+    # jax-free import + a virtual-mode warm round trip, subprocess-pinned
+    import os
+    import subprocess
+    import sys
+
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import sys\n"
+         "from lighthouse_tpu import duty_lookahead as dl\n"
+         "w = dl.DutyLookahead(lambda e: [(1, 2, 3)])\n"
+         "out = w.warm_epoch(5)\n"
+         "assert out['counts']['virtual'] == 1, out\n"
+         "assert w.status()['warmed_epoch'] == 5\n"
+         "assert 'jax' not in sys.modules, "
+         "'duty_lookahead must stay jax-free'\n"],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr
 
 
 def test_watchtower_families_and_catalogue_registered():

@@ -145,6 +145,20 @@ METRICS = (
      ("epoch_flood_leg", "quiet_p99_ms"), None),
     ("epoch_flood_first_sighting_ratio",
      ("epoch_flood_leg", "first_sighting_hit_ratio"), None),
+    # ISSUE 19: the duty-lookahead leg — the canonical flood replayed
+    # reactive-only vs --lookahead. LEARNED, not gated (None
+    # direction): the off/on hit-ratio pair and the on-side flood p99
+    # track the warm's effect; the hard acceptance (on-side ratio 1.0
+    # with zero first sightings, verdict identity, zero host sums in
+    # verify spans) lives in tests/test_duty_lookahead.py
+    ("lookahead_hit_ratio_off",
+     ("lookahead_leg", "off", "first_sighting_hit_ratio"), None),
+    ("lookahead_hit_ratio_on",
+     ("lookahead_leg", "on", "first_sighting_hit_ratio"), None),
+    ("lookahead_hit_ratio_gain",
+     ("lookahead_leg", "hit_ratio_gain"), None),
+    ("lookahead_flood_p99_on_ms",
+     ("lookahead_leg", "on", "flood_p99_ms"), None),
     # ISSUE 18: the watchtower leg — the anomaly evaluator's economics
     # on the acceptance saturation ramp. LEARNED, not gated (None
     # direction): the detection lead (headroom page vs first miss

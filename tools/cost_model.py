@@ -448,6 +448,21 @@ def main() -> None:
       "`epoch_flood_leg` tracks the per-slot p99 spread and the dial; "
       "[OBSERVABILITY.md](OBSERVABILITY.md) chain-time section; "
       "[TRAFFIC_REPLAY.md](TRAFFIC_REPLAY.md)).")
+    w("- First-sighting cost goes to ~zero with duty lookahead "
+      "(ISSUE 19): the remaining ~1/5 above is pure timing — next "
+      "epoch's committee assignments are fully determined one epoch in "
+      "advance, so the duty-lookahead worker "
+      "(`duty_lookahead/`, [DUTY_LOOKAHEAD.md](DUTY_LOOKAHEAD.md)) "
+      "computes each committee's K-point G1 sum OFF the hot path (a "
+      "unit-scalar MSM at the smallest covering rung, host fold on "
+      "fallback) past the mid-epoch trigger and pre-inserts the rows, "
+      "bypassing the repeat-admission gate. The flood replay's dial "
+      "moves 0.8 → 1.0 with zero host EC additions left inside verify "
+      "spans — the K G1-add term above is prepaid in idle time, and "
+      "the epoch-tagged two-epoch retention means the boundary no "
+      "longer risks a wholesale region reset (the bench "
+      "`lookahead_leg` measures the off/on pair; the watchtower floors "
+      "the dial at 0.9).")
     w("- Per-chip scaling (ISSUE 11): every table above prices ONE "
       "chip, and the dp mesh multiplies it — flush plans gain a "
       "(dp_shard × rung) axis, each shard's kind-homogeneous sub-batch "

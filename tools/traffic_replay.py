@@ -385,6 +385,7 @@ def run_timed_replay(
     plan_flushes: bool | None = None,
     slot_s: float = 2.0,
     slots_per_epoch: int = 32,
+    lookahead: bool = False,
 ) -> dict:
     """Drive a live ``VerificationScheduler`` with the trace's arrival
     process: payloads are pre-built (host set construction must not skew
@@ -410,7 +411,18 @@ def run_timed_replay(
     carrying a ``validators`` tuple feed a jax-free committee-sighting
     model mirroring the key table's admission policy (stub and
     cpu-native backends have no device key table to consult — the dial
-    must still be measurable on those replays)."""
+    must still be measurable on those replays).
+
+    Duty-lookahead (ISSUE 19): ``lookahead=True`` drives the REAL
+    worker's synchronous core (``DutyLookahead.warm_epoch``, virtual
+    mode — no key table on stub/cpu replays) over a duty source derived
+    from the trace BEFORE arrivals start: every epoch's committee
+    tuples are warmed off the hot path, the sighting model is
+    prewarmed through the worker's ``on_warmed`` seam, the warms
+    journal ``lookahead_epoch_warmed`` and attribute into the slot
+    ledger's lookahead counters, and the report's ``chain_time`` gains
+    a ``lookahead`` block. First sightings collapse to hits — the
+    acceptance surface for the hit-ratio ≈ 1.0 criterion."""
     from concurrent.futures import ThreadPoolExecutor
 
     from lighthouse_tpu.utils import metrics, slot_clock, slot_ledger
@@ -492,6 +504,34 @@ def run_timed_replay(
     )
     prev_ledger = slot_ledger.configure(enabled=True)
     slot_ledger.reset()
+    lookahead_report = None
+    if lookahead:
+        # the duty-lookahead worker's synchronous core, driven over a
+        # trace-derived duty source BEFORE the arrival process starts
+        # (the live worker warms next-epoch committees from mid-epoch;
+        # a replay compresses that to "warmed ahead of arrivals") —
+        # virtual mode, so the admission prewarm flows through the same
+        # on_warmed seam the harnesses use
+        from lighthouse_tpu import duty_lookahead as dl_mod
+
+        by_epoch: dict = {}
+        for ev in events:
+            vals = ev.get("validators")
+            if vals and len(vals) > 1:
+                e = int(ev["t"] // slot_s) // slots_per_epoch
+                by_epoch.setdefault(e, {})[tuple(vals)] = None
+        worker = dl_mod.DutyLookahead(
+            lambda e: list(by_epoch.get(e, {})),
+            on_warmed=lambda _e, cs: sightings.prewarm(cs),
+        )
+        warms = [worker.warm_epoch(e) for e in sorted(by_epoch)]
+        lookahead_report = {
+            "enabled": True,
+            "epochs_warmed": sum(1 for w in warms if w),
+            "committees": sum(w["committees"] for w in warms if w),
+            "prewarmed": sightings.prewarmed,
+            "worker": worker.status(),
+        }
     t_start = time.monotonic()
     try:
         futures = []
@@ -587,6 +627,9 @@ def run_timed_replay(
             first_sightings=sightings.first,
             sighting_hits=sightings.hits,
             first_sighting_hit_ratio=sightings.hit_ratio(),
+            # present only with --lookahead: off-replays keep the
+            # pre-ISSUE-19 report shape
+            **({"lookahead": lookahead_report} if lookahead_report else {}),
         ),
         "slots": slot_rows,
         "epochs": epoch_rows,
@@ -859,6 +902,15 @@ def main(argv=None) -> int:
         "--slots-per-epoch", type=int, default=32,
         help="slots per epoch for the epoch first-sighting view",
     )
+    run.add_argument(
+        "--lookahead", action="store_true",
+        help="duty-lookahead precompute (ISSUE 19): warm every epoch's "
+        "committee tuples ahead of their arrivals (timed mode drives "
+        "the real worker's warm_epoch over a trace-derived duty "
+        "source; lockstep prewarms the pure admission model) — first "
+        "sightings collapse to hits and the report's chain_time gains "
+        "a lookahead block",
+    )
     out = ap.add_argument_group("output")
     out.add_argument("--json", action="store_true",
                      help="print one JSON report line")
@@ -902,6 +954,7 @@ def main(argv=None) -> int:
             max_batch_sets=args.max_batch,
             shards=list(range(args.dp)) if args.dp > 1 else None,
             slot_s=args.slot_s, slots_per_epoch=args.slots_per_epoch,
+            lookahead=args.lookahead,
         )
         report["trace"] = {
             k: header.get(k) for k in ("name", "seed", "n_events")
@@ -1026,6 +1079,7 @@ def main(argv=None) -> int:
                 plan_flushes=False if args.no_planner else None,
                 slot_s=args.slot_s,
                 slots_per_epoch=args.slots_per_epoch,
+                lookahead=args.lookahead,
             )
         finally:
             if args.watchtower:
